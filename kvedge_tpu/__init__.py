@@ -1,0 +1,39 @@
+"""kvedge-tpu: a TPU-native deployment accelerator for JAX runtimes on Kubernetes.
+
+This package is the idiomatic JAX/TPU re-design of the capabilities of the
+reference accelerator ``levi106/kvedge`` (a Helm chart that boots the Azure
+IoT Edge runtime inside a KubeVirt VM on Kubernetes; see SURVEY.md for the
+full structural analysis).  The reference's five capabilities map here as:
+
+1. Declarative isolated-runtime provisioning
+   (reference: KubeVirt ``VirtualMachine``,
+   ``deployment/helm/templates/aziot-edge-vm.yaml``)
+   -> a single-replica Recreate Deployment pinned to TPU-bearing nodes
+   (:mod:`kvedge_tpu.render`).
+2. Boot-time config injection
+   (reference: Secret -> serial-tagged disk -> cloud-init copy ->
+   ``iotedge config apply``, ``_helper.tpl:61-74``)
+   -> Secret volume -> marker-file mount discovery -> ``kvedge config apply``
+   (:mod:`kvedge_tpu.bootstrap`).
+3. Persistent state across rescheduling
+   (reference: CDI DataVolume / PVC, ``README.md:88``)
+   -> PVC-backed state directory written through by the runtime
+   (:mod:`kvedge_tpu.runtime`).
+4. External access
+   (reference: conditional LoadBalancer SSH service,
+   ``aziot-edge-vm-service.yaml``)
+   -> conditional LoadBalancer exposing SSH and a status endpoint.
+5. Prebuilt boot image
+   (reference: ``deployment/Dockerfile`` containerDisk)
+   -> a runtime OCI image with ``jax[tpu]`` preinstalled
+   (``deployment/Dockerfile``).
+
+On top of the provisioning layer this package carries the minimum end-to-end
+TPU payload (SURVEY.md §7 step 4): a device-visibility check, a sharded
+matmul probe, and a compact flagship transformer whose training step shards
+over a ``jax.sharding.Mesh``.
+"""
+
+from kvedge_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,132 @@
+"""kvedge-tpu CLI — the `helm install`-shaped front door.
+
+The reference's only entry point is the operator's install command
+(``README.md:60``):
+
+    helm install aziotedgeinstance ./deployment/helm \\
+        --set publicSshKey=... --set-file azIotEdgeConfig=config.toml
+
+kvedge-tpu mirrors that interface natively (no helm binary required):
+
+    python -m kvedge_tpu render --set publicSshKey=... \\
+        --set-file jaxRuntimeConfig=config.toml --output-dir ./out
+
+which writes the manifest set for ``kubectl apply -f ./out`` and prints the
+post-install NOTES. The equivalent Helm chart lives at ``deployment/helm``
+for operators who prefer helm itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from kvedge_tpu.config.values import (
+    DEFAULT_VALUES,
+    parse_set_flag,
+    parse_set_file_flag,
+)
+from kvedge_tpu.render import render_all, to_yaml, to_multidoc_yaml
+from kvedge_tpu.render.manifests import render_notes
+from kvedge_tpu.version import CHART_NAME, CHART_VERSION
+
+
+def _add_value_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--set",
+        dest="sets",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="override a chart value (helm --set analogue)",
+    )
+    parser.add_argument(
+        "--set-file",
+        dest="set_files",
+        action="append",
+        default=[],
+        metavar="KEY=PATH",
+        help="set a chart value from a file (helm --set-file analogue)",
+    )
+
+
+def _resolve_values(args: argparse.Namespace):
+    values = DEFAULT_VALUES
+    for assignment in args.sets:
+        values = parse_set_flag(values, assignment)
+    for assignment in args.set_files:
+        values = parse_set_file_flag(values, assignment)
+    return values
+
+
+def cmd_render(args: argparse.Namespace) -> int:
+    values = _resolve_values(args)
+    chart = render_all(values)
+    if args.golden or args.output_dir:
+        out = pathlib.Path(args.golden or args.output_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        for filename, doc in chart.ordered():
+            (out / filename).write_text(to_yaml(doc))
+        if args.golden:
+            (out / "NOTES.txt").write_text(chart.notes)
+            print(f"wrote golden render to {out}", file=sys.stderr)
+        else:
+            print(f"wrote {len(chart.manifests)} manifests to {out}", file=sys.stderr)
+            print(chart.notes, file=sys.stderr)
+    else:
+        print(to_multidoc_yaml([doc for _, doc in chart.ordered()]), end="")
+        print(chart.notes, file=sys.stderr)
+    return 0
+
+
+def cmd_notes(args: argparse.Namespace) -> int:
+    print(render_notes(_resolve_values(args)), end="")
+    return 0
+
+
+def cmd_version(args: argparse.Namespace) -> int:
+    print(f"{CHART_NAME} {CHART_VERSION}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kvedge-tpu",
+        description="TPU-native deployment accelerator for JAX runtimes on K8s.",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_render = sub.add_parser(
+        "render", help="render the manifest set (helm template/install analogue)"
+    )
+    _add_value_flags(p_render)
+    p_render.add_argument(
+        "--output-dir", help="write manifests here instead of stdout"
+    )
+    p_render.add_argument(
+        "--golden", help=argparse.SUPPRESS  # regenerate golden test fixtures
+    )
+    p_render.set_defaults(func=cmd_render)
+
+    p_notes = sub.add_parser("notes", help="print post-install usage notes")
+    _add_value_flags(p_notes)
+    p_notes.set_defaults(func=cmd_notes)
+
+    p_version = sub.add_parser("version", help="print chart/app version")
+    p_version.set_defaults(func=cmd_version)
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,17 @@
+"""Configuration surface: chart values and the opaque runtime config payload.
+
+Two-tier config, mirroring the reference (SURVEY.md §5 "Config / flag system"):
+
+(a) chart values — exactly six flags, the analogue of
+    ``deployment/helm/values.yaml:1-17`` (:mod:`kvedge_tpu.config.values`);
+(b) opaque payload config — a TOML document passed by file, base64'd into a
+    Secret, surfaced in the container as a mounted file, and applied by the
+    bootstrap step (:mod:`kvedge_tpu.config.runtime_config`), the analogue of
+    the IoT Edge ``config.toml`` pipeline
+    (``aziot-edge-runtime-config-secret.yaml:6`` -> ``_helper.tpl:61-74``).
+"""
+
+from kvedge_tpu.config.values import ChartValues, DEFAULT_VALUES
+from kvedge_tpu.config.runtime_config import RuntimeConfig
+
+__all__ = ["ChartValues", "DEFAULT_VALUES", "RuntimeConfig"]

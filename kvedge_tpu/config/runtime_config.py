@@ -1,0 +1,206 @@
+"""The opaque runtime-config payload: a TOML document, applied at boot.
+
+This is the analogue of the IoT Edge ``config.toml`` the reference treats as
+an opaque value: the operator passes a TOML file at install time
+(``--set-file azIotEdgeConfig=config.toml``, reference ``README.md:60``), the
+chart base64's it into a Secret under the key ``userdata``
+(``aziot-edge-runtime-config-secret.yaml:6``), the Secret surfaces in the
+guest as a serial-tagged disk, and cloud-init copies it to
+``/etc/aziot/config.toml`` and runs ``iotedge config apply``
+(``_helper.tpl:70-74``).
+
+Here the payload is the JAX runtime's config: mesh shape, expected TPU
+topology, state/heartbeat layout, status endpoint, and which payload to run.
+``kvedge config apply`` (:func:`RuntimeConfig.apply`) validates it and
+materializes it at ``/etc/kvedge/config.toml``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tomllib
+from typing import Mapping
+
+
+def _toml_str(value: str) -> str:
+    """Quote a string as a TOML basic string (JSON escaping is TOML-valid)."""
+    return json.dumps(value, ensure_ascii=True)
+
+DEFAULT_CONFIG_PATH = "/etc/kvedge/config.toml"
+DEFAULT_STATE_DIR = "/var/lib/kvedge/state"
+
+_VALID_PAYLOADS = ("devicecheck", "transformer-probe", "none")
+
+
+class RuntimeConfigError(ValueError):
+    """Raised when the runtime config TOML fails validation."""
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical device-mesh shape the runtime should assemble.
+
+    Axis order is meaningful: it is the order handed to
+    ``jax.sharding.Mesh``. A zero value means "infer from device count"
+    (at most one axis may be zero).
+    """
+
+    axes: tuple[tuple[str, int], ...] = (("data", 1), ("model", 1))
+
+    def validate(self) -> None:
+        if not self.axes:
+            raise RuntimeConfigError("[mesh] axes must be a non-empty table")
+        for axis, size in self.axes:
+            if not axis:
+                raise RuntimeConfigError("mesh axis names must be non-empty")
+            if not isinstance(size, int) or isinstance(size, bool) or size < 0:
+                raise RuntimeConfigError(
+                    f"mesh axis {axis!r} size must be a non-negative int"
+                )
+        names = self.axis_names()
+        if len(set(names)) != len(names):
+            raise RuntimeConfigError(f"duplicate mesh axis names in {names}")
+        if sum(1 for _, size in self.axes if size == 0) > 1:
+            raise RuntimeConfigError("at most one mesh axis may be 0 (inferred)")
+
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(name for name, _ in self.axes)
+
+    def resolved_shape(self, n_devices: int) -> tuple[int, ...]:
+        """Concrete mesh shape for ``n_devices``, inferring any zero axis."""
+        sizes = [size for _, size in self.axes]
+        self.validate()
+        zeros = [i for i, s in enumerate(sizes) if s == 0]
+        fixed = 1
+        for s in sizes:
+            if s:
+                fixed *= s
+        if zeros:
+            if n_devices % fixed:
+                raise RuntimeConfigError(
+                    f"{n_devices} devices not divisible by fixed axes ({fixed})"
+                )
+            sizes[zeros[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise RuntimeConfigError(
+                f"mesh {dict(self.axes)} wants {fixed} devices, have {n_devices}"
+            )
+        return tuple(sizes)
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Validated runtime config (the parsed form of the opaque TOML)."""
+
+    name: str = "kvedge-tpu"
+    state_dir: str = DEFAULT_STATE_DIR
+    heartbeat_interval_s: float = 10.0
+    expected_platform: str = "tpu"
+    expected_chips: int = 0  # 0 = accept whatever is visible
+    mesh: MeshSpec = MeshSpec()
+    status_port: int = 8476
+    status_bind: str = "0.0.0.0"
+    payload: str = "devicecheck"
+
+    @classmethod
+    def parse(cls, text: str) -> "RuntimeConfig":
+        """Parse and validate the TOML document."""
+        try:
+            doc = tomllib.loads(text)
+        except tomllib.TOMLDecodeError as e:
+            raise RuntimeConfigError(f"invalid TOML: {e}") from e
+        return cls.from_mapping(doc)
+
+    @classmethod
+    def from_mapping(cls, doc: Mapping) -> "RuntimeConfig":
+        runtime = dict(doc.get("runtime", {}))
+        tpu = dict(doc.get("tpu", {}))
+        mesh_doc = dict(doc.get("mesh", {}))
+        status = dict(doc.get("status", {}))
+        payload_doc = dict(doc.get("payload", {}))
+
+        axes_doc = mesh_doc.get("axes", {"data": 1, "model": 1})
+        if not isinstance(axes_doc, Mapping):
+            raise RuntimeConfigError("[mesh] axes must be a table")
+        axes = [(str(axis), size) for axis, size in axes_doc.items()]
+
+        try:
+            cfg = cls(
+                name=str(runtime.get("name", cls.name)),
+                state_dir=str(runtime.get("state_dir", cls.state_dir)),
+                heartbeat_interval_s=float(
+                    runtime.get("heartbeat_interval_s", cls.heartbeat_interval_s)
+                ),
+                expected_platform=str(tpu.get("platform", cls.expected_platform)),
+                expected_chips=int(tpu.get("expected_chips", cls.expected_chips)),
+                mesh=MeshSpec(axes=tuple(axes)),
+                status_port=int(status.get("port", cls.status_port)),
+                status_bind=str(status.get("bind", cls.status_bind)),
+                payload=str(payload_doc.get("kind", cls.payload)),
+            )
+        except (TypeError, ValueError) as e:
+            if isinstance(e, RuntimeConfigError):
+                raise
+            raise RuntimeConfigError(f"wrongly-typed config value: {e}") from e
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if not self.name:
+            raise RuntimeConfigError("[runtime] name must be non-empty")
+        if self.heartbeat_interval_s <= 0:
+            raise RuntimeConfigError("[runtime] heartbeat_interval_s must be > 0")
+        if self.expected_chips < 0:
+            raise RuntimeConfigError("[tpu] expected_chips must be >= 0")
+        if not (0 < self.status_port < 65536):
+            raise RuntimeConfigError("[status] port out of range")
+        if self.payload not in _VALID_PAYLOADS:
+            raise RuntimeConfigError(
+                f"[payload] kind must be one of {_VALID_PAYLOADS}, "
+                f"got {self.payload!r}"
+            )
+        self.mesh.validate()
+
+    def to_toml(self) -> str:
+        """Serialize back to TOML (the form written by ``config apply``).
+
+        String values are emitted as TOML basic strings via JSON escaping
+        (valid TOML: ``\"``, ``\\``, ``\\uXXXX``), so quotes/backslashes in
+        names or paths survive the apply -> re-parse round trip.
+        """
+        s = _toml_str
+        axes = ", ".join(f"{s(name)} = {size}" for name, size in self.mesh.axes)
+        return (
+            "[runtime]\n"
+            f"name = {s(self.name)}\n"
+            f"state_dir = {s(self.state_dir)}\n"
+            f"heartbeat_interval_s = {self.heartbeat_interval_s}\n"
+            "\n[tpu]\n"
+            f"platform = {s(self.expected_platform)}\n"
+            f"expected_chips = {self.expected_chips}\n"
+            "\n[mesh]\n"
+            f"axes = {{ {axes} }}\n"
+            "\n[status]\n"
+            f"port = {self.status_port}\n"
+            f"bind = {s(self.status_bind)}\n"
+            "\n[payload]\n"
+            f"kind = {s(self.payload)}\n"
+        )
+
+    def apply(self, config_path: str = DEFAULT_CONFIG_PATH) -> str:
+        """Materialize the validated config — ``iotedge config apply`` analog.
+
+        Writes the canonical TOML to ``config_path`` and creates the state
+        directory, so a subsequent runtime boot finds both in place
+        (reference: ``_helper.tpl:73-74``).
+        """
+        self.validate()
+        os.makedirs(os.path.dirname(config_path), exist_ok=True)
+        os.makedirs(self.state_dir, exist_ok=True)
+        tmp = config_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(self.to_toml())
+        os.replace(tmp, config_path)
+        return config_path

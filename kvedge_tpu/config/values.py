@@ -1,0 +1,130 @@
+"""The chart's entire user-facing config surface: six values.
+
+This mirrors the reference's ``deployment/helm/values.yaml`` value-for-value
+(SURVEY.md §2 #2). The mapping, with the reference value each one replaces:
+
+====================================  =========================================
+reference (values.yaml)               kvedge-tpu
+====================================  =========================================
+``aziotEdgeVmDiskSize`` (4Gi, :2)     ``tpuRuntimeDiskSize`` — state PVC size
+``aziotEdgeVmEnableExternalSsh``      ``tpuRuntimeEnableExternalSsh`` — gate
+  (true, :5)                            for the LoadBalancer access service
+``nameOverride``                      ``nameOverride`` — resource-name prefix,
+  (chart name, :8)                      defaults to chart name, trunc 40
+``publicSshKey`` ("", :11)            ``publicSshKey`` — authorized key for
+                                        the in-pod sshd
+``azIotEdgeConfig`` ("", :14)         ``jaxRuntimeConfig`` — opaque runtime
+                                        TOML passed with ``--set-file``
+``macAddress``                        ``tpuAccelerator`` — stable hardware
+  (fe:7e:48:a0:7d:22, :17)              identity: the GKE TPU accelerator
+                                        node-selector value. (The reference
+                                        pins a MAC so the VM's NIC identity
+                                        survives restarts; on TPU nodes the
+                                        identity that must stay stable across
+                                        rescheduling is the accelerator type.)
+====================================  =========================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from kvedge_tpu.version import CHART_NAME
+
+_DISK_SIZE_RE = re.compile(r"^[1-9][0-9]*(Ei|Pi|Ti|Gi|Mi|Ki|E|P|T|G|M|K)?$")
+# GKE TPU accelerator node-selector values are DNS-label-ish tokens.
+_ACCELERATOR_RE = re.compile(r"^[a-z0-9]([a-z0-9-]*[a-z0-9])?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChartValues:
+    """The six chart values (see module docstring for the reference mapping)."""
+
+    # State PVC size (reference: aziotEdgeVmDiskSize, values.yaml:2).
+    tpuRuntimeDiskSize: str = "4Gi"
+    # Create a LoadBalancer service for external SSH/status access
+    # (reference: aziotEdgeVmEnableExternalSsh, values.yaml:5).
+    tpuRuntimeEnableExternalSsh: bool = True
+    # Resource-name prefix; defaults to the chart name and is truncated to 40
+    # chars by the name helper (reference: nameOverride, values.yaml:8).
+    nameOverride: str = CHART_NAME
+    # SSH public key authorized inside the runtime pod
+    # (reference: publicSshKey, values.yaml:11).
+    publicSshKey: str = ""
+    # Opaque runtime config TOML, usually passed via --set-file
+    # (reference: azIotEdgeConfig, values.yaml:14).
+    jaxRuntimeConfig: str = ""
+    # Stable hardware identity: GKE TPU accelerator type for the node selector
+    # (reference: macAddress, values.yaml:17).
+    tpuAccelerator: str = "tpu-v5-lite-podslice"
+
+    def validate(self) -> None:
+        # Resource names must be RFC 1123 labels after the prefix is applied;
+        # empty means "fall back to the chart name" (the helper's `default`).
+        if self.nameOverride and not _ACCELERATOR_RE.match(self.nameOverride):
+            raise ValueError(
+                f"nameOverride {self.nameOverride!r} is not a valid Kubernetes "
+                "resource-name prefix (lowercase RFC 1123)"
+            )
+        if not _DISK_SIZE_RE.match(self.tpuRuntimeDiskSize):
+            raise ValueError(
+                f"tpuRuntimeDiskSize {self.tpuRuntimeDiskSize!r} is not a "
+                "valid Kubernetes quantity (e.g. 4Gi)"
+            )
+        if not isinstance(self.tpuRuntimeEnableExternalSsh, bool):
+            raise ValueError("tpuRuntimeEnableExternalSsh must be a bool")
+        if not _ACCELERATOR_RE.match(self.tpuAccelerator):
+            raise ValueError(
+                f"tpuAccelerator {self.tpuAccelerator!r} is not a valid "
+                "node-selector value"
+            )
+
+    def replace(self, **kwargs) -> "ChartValues":
+        values = dataclasses.replace(self, **kwargs)
+        values.validate()
+        return values
+
+
+DEFAULT_VALUES = ChartValues()
+
+_BOOL_VALUES = {"true": True, "false": False}
+
+
+def parse_set_flag(values: ChartValues, assignment: str) -> ChartValues:
+    """Apply one ``--set key=value`` assignment, helm-style.
+
+    Mirrors the install interface of ``helm install --set ...``
+    (reference ``README.md:60``). Booleans accept ``true``/``false``.
+    """
+    key, sep, raw = assignment.partition("=")
+    if not sep:
+        raise ValueError(f"--set expects key=value, got {assignment!r}")
+    if key not in {f.name for f in dataclasses.fields(ChartValues)}:
+        raise ValueError(f"unknown value {key!r}")
+    current = getattr(values, key)
+    if isinstance(current, bool):
+        if raw.lower() not in _BOOL_VALUES:
+            raise ValueError(f"{key} expects true or false, got {raw!r}")
+        parsed: object = _BOOL_VALUES[raw.lower()]
+    else:
+        parsed = raw
+    return values.replace(**{key: parsed})
+
+
+def parse_set_file_flag(values: ChartValues, assignment: str) -> ChartValues:
+    """Apply one ``--set-file key=path`` assignment, helm-style.
+
+    The reference passes the opaque IoT Edge config this way:
+    ``--set-file azIotEdgeConfig=config.toml`` (``README.md:60``).
+    """
+    key, sep, path = assignment.partition("=")
+    if not sep:
+        raise ValueError(f"--set-file expects key=path, got {assignment!r}")
+    with open(path, "r", encoding="utf-8") as fh:
+        content = fh.read()
+    if key not in {f.name for f in dataclasses.fields(ChartValues)}:
+        raise ValueError(f"unknown value {key!r}")
+    if isinstance(getattr(values, key), bool):
+        raise ValueError(f"{key} cannot be set from a file")
+    return values.replace(**{key: content})

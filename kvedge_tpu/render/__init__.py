@@ -1,0 +1,21 @@
+"""Manifest rendering: values -> Kubernetes objects.
+
+This is the L1/L2 mirror (SURVEY.md §1): where the reference renders five
+manifests through Helm (`deployment/helm/templates/*`), kvedge-tpu renders
+the same shapes natively in Python — golden-testable with no cluster and no
+helm binary — and ships an equivalent Helm chart under ``deployment/helm``
+kept byte-identical to this renderer by a consistency test.
+"""
+
+from kvedge_tpu.render.names import resource_name, common_labels
+from kvedge_tpu.render.manifests import render_all, RenderedChart
+from kvedge_tpu.render.emit import to_yaml, to_multidoc_yaml
+
+__all__ = [
+    "resource_name",
+    "common_labels",
+    "render_all",
+    "RenderedChart",
+    "to_yaml",
+    "to_multidoc_yaml",
+]

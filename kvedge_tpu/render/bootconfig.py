@@ -1,0 +1,79 @@
+"""The boot-config document — the cloud-init user-data analogue.
+
+Reference: ``aziotedgevm.cloudinit`` (``_helper.tpl:31-75``) renders a
+``#cloud-config`` document that (a) sets the hostname, (b) authorizes the
+operator's SSH key, (c) ``bootcmd``-mounts the config-secret disk found *by
+serial* at ``/mnt/app-secret`` (:61-64), and (d) ``runcmd``-installs the
+runtime and applies the injected config (:68-74). The document travels as a
+Secret (``aziot-edge-vm-cloud-init-secret.yaml``) so boot behavior is data,
+changeable without rebuilding the boot image.
+
+kvedge-tpu keeps the same shape: a ``#kvedge-boot-config`` YAML document,
+shipped as a Secret, parsed and executed by
+:mod:`kvedge_tpu.bootstrap.entrypoint` inside the runtime container. The
+apt-install steps have no analogue (the runtime image ships with ``jax[tpu]``
+preinstalled — that is the containerDisk capability, ``deployment/Dockerfile``),
+so ``runcmd`` goes straight to config-apply + runtime boot.
+
+Identity-based config discovery: the reference tags the config disk with the
+serial ``D23YZ9W6WA5DJ487`` and the guest greps ``lsblk`` for it. Pods have
+no disk serials, so kvedge-tpu mounts the config Secret under a
+serial-named directory (``/mnt/disks/<serial>``) and the bootstrap scans the
+search root for that serial — identity-addressed, not path-hardcoded, like
+the reference.
+"""
+
+from __future__ import annotations
+
+import json
+
+from kvedge_tpu.config.values import ChartValues
+
+# The config-volume serial tag (analogue of D23YZ9W6WA5DJ487,
+# aziot-edge-vm.yaml:28). A fresh token — not the reference's.
+CONFIG_SERIAL = "KV9TPU3EDGE7R412"
+
+# Where the pod spec mounts serial-tagged volumes; bootstrap scans this root.
+DISKS_ROOT = "/mnt/disks"
+
+# Stable link the bootstrap creates once the serial is located
+# (analogue of the `/mnt/app-secret` mount point, _helper.tpl:62-64).
+APP_SECRET_MOUNT = "/mnt/app-secret"
+
+# Where the boot-config Secret is mounted (analogue of the NoCloud cdrom).
+BOOT_SECRET_MOUNT = "/mnt/boot-secret"
+
+# Fixed in-pod hostname (analogue of `hostname: iotedgevm`, _helper.tpl:33).
+RUNTIME_HOSTNAME = "kvedgetpuvm"
+
+HEADER = "#kvedge-boot-config"
+
+
+def boot_config_document(values: ChartValues) -> str:
+    """Render the boot-config YAML (the ``aziotedgevm.cloudinit`` analogue).
+
+    Emitted as literal text (not via a YAML dumper) so the document is
+    byte-stable for golden tests and for the Helm-chart consistency check.
+    The SSH key is JSON-quoted (valid YAML double-quoted scalar, matching
+    Helm's ``toJson``): an empty key stays a string instead of parsing as
+    YAML ``null``, and keys containing ``: `` or ``#`` can't corrupt the
+    document.
+    """
+    ssh_key = json.dumps(values.publicSshKey, ensure_ascii=True)
+    return (
+        f"{HEADER}\n"
+        f"hostname: {RUNTIME_HOSTNAME}\n"
+        "ssh_authorized_keys:\n"
+        f"  - {ssh_key}\n"
+        "bootcmd:\n"
+        "# locate the config Secret volume by serial and link it\n"
+        f'  - "kvedge-bootstrap locate --serial {CONFIG_SERIAL}'
+        f' --search-root {DISKS_ROOT} --link {APP_SECRET_MOUNT}"\n'
+        "# Once the pod is started the following commands apply the injected\n"
+        "# runtime config and boot the JAX runtime. The runtime image ships\n"
+        "# with jax[tpu] preinstalled, so there is no package-install step.\n"
+        "runcmd:\n"
+        f'  - "kvedge-bootstrap apply --source {APP_SECRET_MOUNT}/userdata'
+        ' --target /etc/kvedge/config.toml"\n'
+        '  - "kvedge-runtime boot --config /etc/kvedge/config.toml"\n'
+    )

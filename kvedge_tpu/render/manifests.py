@@ -1,0 +1,360 @@
+"""Build the chart's Kubernetes manifests as plain dicts.
+
+Each builder mirrors one reference template (SURVEY.md §2 #4-#9); the
+reference file is cited per function. The rendered set is:
+
+==============================================  ================================
+kvedge-tpu manifest                             reference template
+==============================================  ================================
+``jax-tpu-runtime.yaml`` (Deployment)           ``aziot-edge-vm.yaml`` (VM)
+``jax-tpu-state-volume.yaml`` (PVC)             ``aziot-edge-data-volume-container.yaml``
+``jax-tpu-state-volume-prepopulated.yaml``      ``aziot-edge-data-volume-disk.yaml``
+  (dead alternative, excluded by .helmignore)     (dead alternative, excluded)
+``jax-tpu-runtime-config-secret.yaml``          ``aziot-edge-runtime-config-secret.yaml``
+``jax-tpu-boot-config-secret.yaml``             ``aziot-edge-vm-cloud-init-secret.yaml``
+``jax-tpu-runtime-service.yaml`` (conditional)  ``aziot-edge-vm-service.yaml``
+==============================================  ================================
+
+The KubeVirt VM becomes a ``Deployment`` with ``replicas: 1`` and
+``strategy: Recreate`` holding a ReadWriteOnce state PVC: on node failure the
+controller reschedules the pod and the PVC re-attaches — the same resilience
+story (and the same node-bound-PVC caveat) as the reference's VM + DataVolume
+(``README.md:88-89``). ``Recreate`` guarantees at most one pod holds the RWO
+volume, as only one VM held the reference's boot disk.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+
+from kvedge_tpu.config.runtime_config import RuntimeConfig
+from kvedge_tpu.config.values import ChartValues
+from kvedge_tpu.render import bootconfig
+from kvedge_tpu.render.names import (
+    DOMAIN_LABEL,
+    OS_LABEL,
+    common_labels,
+    resource_name,
+)
+from kvedge_tpu.version import APP_VERSION, CHART_NAME
+
+# The prebuilt runtime image (capability 5) — the containerDisk analogue of
+# `docker://suneetnangia/ubuntu-container-disk:18.04`
+# (aziot-edge-data-volume-container.yaml:12). Built by deployment/Dockerfile.
+RUNTIME_IMAGE = f"kvedgedev/jax-tpu-runtime:{APP_VERSION}"
+
+# GKE TPU node-selector key; the value comes from values.tpuAccelerator.
+TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+
+# Hardcoded pod resources, mirroring the reference's fixed VM size:
+# 4 cores (aziot-edge-vm.yaml:18), 4096M (aziot-edge-vm.yaml:41), and the
+# TPU chips of one host (the analogue of the VM owning its node's cores).
+POD_CPU = "4"
+POD_MEMORY = "4096M"
+TPU_RESOURCE = "google.com/tpu"
+TPU_CHIPS = 4
+
+STATE_MOUNT = "/var/lib/kvedge/state"
+SSH_PORT = 22
+# Default status port is owned by RuntimeConfig; the rendered containerPort /
+# Service / NOTES follow the operator's [status] port when a runtime config
+# is provided (see status_port()), so the two can't drift.
+STATUS_PORT = RuntimeConfig.status_port
+
+
+def status_port(values: ChartValues) -> int:
+    """The status port the manifests must expose.
+
+    Parsing the opaque runtime config here also validates it at render time
+    — a failure mode the reference only surfaced inside the booted VM
+    (`iotedge config apply` failing post-install, `_helper.tpl:74`) fails
+    the install command instead.
+    """
+    if not values.jaxRuntimeConfig:
+        return STATUS_PORT
+    return RuntimeConfig.parse(values.jaxRuntimeConfig).status_port
+
+
+def _b64(text: str) -> str:
+    return base64.b64encode(text.encode("utf-8")).decode("ascii")
+
+
+def state_volume(values: ChartValues) -> dict:
+    """State PVC — the DataVolume analogue.
+
+    Reference: ``aziot-edge-data-volume-container.yaml`` — a CDI DataVolume
+    importing a prebuilt boot disk into a ReadWriteOnce PVC sized by
+    ``aziotEdgeVmDiskSize``. Pods boot from the OCI image instead of a disk,
+    so the PVC holds only durable runtime *state* (heartbeats, checkpoints);
+    it is dynamically provisioned from the cluster's default storage class.
+    """
+    name = resource_name(values.nameOverride)
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {
+            "name": f"{name}-runtime-dv",
+            "labels": common_labels(),
+        },
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": values.tpuRuntimeDiskSize}},
+        },
+    }
+
+
+def state_volume_prepopulated(values: ChartValues) -> dict:
+    """Dead alternative to :func:`state_volume` — excluded from packaging.
+
+    Reference: ``aziot-edge-data-volume-disk.yaml`` renders a DataVolume with
+    the *same name* sourced over HTTP, and is excluded by ``.helmignore:24``
+    ("takes ~30 mins to import"); only the ignore file prevents a name
+    collision (SURVEY.md §2 #6). The analogue here: a PVC of the same name
+    prepopulated from a volume snapshot, likewise excluded by
+    ``deployment/helm/.helmignore`` and by :func:`render_all`.
+    """
+    doc = state_volume(values)
+    doc["spec"]["dataSourceRef"] = {
+        "apiGroup": "snapshot.storage.k8s.io",
+        "kind": "VolumeSnapshot",
+        "name": "jax-tpu-runtime-state-seed",
+    }
+    return doc
+
+
+def runtime_config_secret(values: ChartValues) -> dict:
+    """Opaque runtime-config Secret.
+
+    Reference: ``aziot-edge-runtime-config-secret.yaml`` — the user's
+    config.toml base64'd under the key ``userdata``.
+    """
+    name = resource_name(values.nameOverride)
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": f"{name}-runtime-jaxconfig"},
+        "data": {"userdata": _b64(values.jaxRuntimeConfig)},
+    }
+
+
+def boot_config_secret(values: ChartValues) -> dict:
+    """Boot-config Secret — the cloud-init Secret analogue.
+
+    Reference: ``aziot-edge-vm-cloud-init-secret.yaml``. The reference names
+    this Secret with raw ``.Values.nameOverride`` (its :4; latent mismatch
+    noted at ``aziot-edge-vm.yaml:57``); kvedge-tpu uses the name helper —
+    see the divergence note in :mod:`kvedge_tpu.render.names`.
+    """
+    name = resource_name(values.nameOverride)
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": {"name": f"{name}-runtime-bootconfig"},
+        "data": {"userdata": _b64(bootconfig.boot_config_document(values))},
+    }
+
+
+def runtime_deployment(values: ChartValues) -> dict:
+    """The core resource: the JAX runtime Deployment — the VM analogue.
+
+    Reference: ``aziot-edge-vm.yaml``. Correspondences:
+
+    * ``running: true`` (:9) -> ``replicas: 1`` + ``strategy: Recreate``;
+    * 4 cores / q35 / 4096M (:18,:37,:41) -> cpu 4 / memory 4096M requests
+      plus this host's TPU chips;
+    * bootdisk -> DataVolume (:46-48) -> the state PVC mount;
+    * serial-tagged config disk -> Secret (:25-28,:49-51) -> the config
+      Secret mounted under ``/mnt/disks/<serial>``;
+    * cloudInitNoCloud cdrom (:29-31,:52-57) -> the boot-config Secret
+      mounted at ``/mnt/boot-secret``, consumed by the entrypoint;
+    * masquerade NIC + static MAC (:32-35) -> TPU-accelerator node selector
+      (the stable hardware identity) + pod networking;
+    * ``kubevirt.io/domain`` label (:14) -> ``kvedge.dev/domain``.
+    """
+    name = resource_name(values.nameOverride)
+    port = status_port(values)
+    pod_labels = dict(common_labels())
+    pod_labels[DOMAIN_LABEL] = f"{name}-runtime"
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "labels": {OS_LABEL: "linux"},
+            "name": f"{name}-runtime",
+        },
+        "spec": {
+            "replicas": 1,
+            "strategy": {"type": "Recreate"},
+            "selector": {"matchLabels": {DOMAIN_LABEL: f"{name}-runtime"}},
+            "template": {
+                "metadata": {"labels": pod_labels},
+                "spec": {
+                    "hostname": bootconfig.RUNTIME_HOSTNAME,
+                    "nodeSelector": {
+                        TPU_ACCELERATOR_SELECTOR: values.tpuAccelerator
+                    },
+                    "containers": [
+                        {
+                            "name": "runtime",
+                            "image": RUNTIME_IMAGE,
+                            "command": [
+                                "python",
+                                "-m",
+                                "kvedge_tpu.bootstrap.entrypoint",
+                                "--boot-config",
+                                f"{bootconfig.BOOT_SECRET_MOUNT}/userdata",
+                            ],
+                            "ports": [
+                                {"containerPort": SSH_PORT, "name": "ssh"},
+                                {"containerPort": port, "name": "status"},
+                            ],
+                            "resources": {
+                                "requests": {
+                                    "cpu": POD_CPU,
+                                    "memory": POD_MEMORY,
+                                },
+                                "limits": {TPU_RESOURCE: TPU_CHIPS},
+                            },
+                            "volumeMounts": [
+                                {
+                                    "name": "statedisk",
+                                    "mountPath": STATE_MOUNT,
+                                },
+                                {
+                                    "name": "jaxconfigdisk",
+                                    "mountPath": (
+                                        f"{bootconfig.DISKS_ROOT}/"
+                                        f"{bootconfig.CONFIG_SERIAL}"
+                                    ),
+                                    "readOnly": True,
+                                },
+                                {
+                                    "name": "bootconfigdisk",
+                                    "mountPath": bootconfig.BOOT_SECRET_MOUNT,
+                                    "readOnly": True,
+                                },
+                            ],
+                        }
+                    ],
+                    "volumes": [
+                        {
+                            "name": "statedisk",
+                            "persistentVolumeClaim": {
+                                "claimName": f"{name}-runtime-dv"
+                            },
+                        },
+                        {
+                            "name": "jaxconfigdisk",
+                            "secret": {
+                                "secretName": f"{name}-runtime-jaxconfig"
+                            },
+                        },
+                        {
+                            "name": "bootconfigdisk",
+                            "secret": {
+                                "secretName": f"{name}-runtime-bootconfig"
+                            },
+                        },
+                    ],
+                },
+            },
+        },
+    }
+
+
+def access_service(values: ChartValues) -> dict | None:
+    """Conditional LoadBalancer for external SSH + status access.
+
+    Reference: ``aziot-edge-vm-service.yaml`` — rendered only when the
+    enable flag is true (:1), LoadBalancer on TCP 22 (:13-17), selecting the
+    runtime pod by domain label (:10-11), ``externalTrafficPolicy: Cluster``
+    (:9). kvedge-tpu adds the status port alongside SSH.
+    """
+    if not values.tpuRuntimeEnableExternalSsh:
+        return None
+    name = resource_name(values.nameOverride)
+    port = status_port(values)
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "labels": common_labels(),
+            "name": f"{name}-runtime-ssh-service",
+        },
+        "spec": {
+            "externalTrafficPolicy": "Cluster",
+            "selector": {DOMAIN_LABEL: f"{name}-runtime"},
+            "ports": [
+                {
+                    "name": "ssh",
+                    "protocol": "TCP",
+                    "port": SSH_PORT,
+                    "targetPort": SSH_PORT,
+                },
+                {
+                    "name": "status",
+                    "protocol": "TCP",
+                    "port": port,
+                    "targetPort": port,
+                },
+            ],
+            "type": "LoadBalancer",
+        },
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class RenderedChart:
+    """The rendered manifest set, keyed by output filename."""
+
+    manifests: dict[str, dict]
+    notes: str
+
+    def ordered(self) -> list[tuple[str, dict]]:
+        return sorted(self.manifests.items())
+
+
+def render_notes(values: ChartValues) -> str:
+    """Post-install usage text (reference: ``templates/NOTES.txt``)."""
+    name = resource_name(values.nameOverride)
+    return (
+        f"You have installed release {APP_VERSION} of {CHART_NAME}.\n"
+        "\n"
+        "To check the status of the newly created JAX TPU runtime, try:\n"
+        f"kubectl get deployment {name}-runtime\n"
+        "\n"
+        "To query the runtime status endpoint (once the pod is running):\n"
+        f"curl http://$(kubectl get service {name}-runtime-ssh-service "
+        "--output jsonpath='{.status.loadBalancer.ingress[0].ip}')"
+        f":{status_port(values)}/status\n"
+        "\n"
+        "To connect to the runtime pod over SSH:\n"
+        f"ssh kvedge@$(kubectl get service {name}-runtime-ssh-service "
+        "--output jsonpath='{.status.loadBalancer.ingress[0].ip}')\n"
+    )
+
+
+def render_all(values: ChartValues, include_dead: bool = False) -> RenderedChart:
+    """Render the full manifest set.
+
+    ``include_dead=False`` mirrors the packaging exclusion of the
+    prepopulated-volume alternative (reference ``.helmignore:23-24``): the
+    dead template exists in the chart source but is never rendered; if it
+    were, its name would collide with the live state volume.
+    """
+    values.validate()
+    manifests: dict[str, dict] = {
+        "jax-tpu-runtime.yaml": runtime_deployment(values),
+        "jax-tpu-state-volume.yaml": state_volume(values),
+        "jax-tpu-runtime-config-secret.yaml": runtime_config_secret(values),
+        "jax-tpu-boot-config-secret.yaml": boot_config_secret(values),
+    }
+    if include_dead:
+        manifests["jax-tpu-state-volume-prepopulated.yaml"] = (
+            state_volume_prepopulated(values)
+        )
+    service = access_service(values)
+    if service is not None:
+        manifests["jax-tpu-runtime-service.yaml"] = service
+    return RenderedChart(manifests=manifests, notes=render_notes(values))

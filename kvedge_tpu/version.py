@@ -1,0 +1,16 @@
+"""Chart and application version, mirroring the reference's identity surface.
+
+Reference: ``deployment/helm/Chart.yaml:19,23`` pins ``version: 0.1.0`` and
+``appVersion: 0.1.0``; both are surfaced here for the renderer and the chart.
+"""
+
+__version__ = "0.1.0"
+
+CHART_NAME = "kvedge-tpu"
+CHART_VERSION = __version__
+APP_VERSION = __version__
+CHART_DESCRIPTION = (
+    "A Helm chart for deploying a resilient JAX TPU runtime on K8s as a "
+    "PVC-backed single-replica Deployment."
+)
+CHART_KEYWORDS = ("jax", "tpu", "kvedge")

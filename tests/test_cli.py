@@ -1,0 +1,48 @@
+"""CLI behavior: the helm-install-shaped front door."""
+
+import yaml
+
+from kvedge_tpu.cli import main
+
+
+def test_render_stdout(capsys):
+    assert main(["render"]) == 0
+    out = capsys.readouterr()
+    docs = list(yaml.safe_load_all(out.out))
+    assert len(docs) == 5
+    assert "You have installed release" in out.err
+
+
+def test_render_with_sets_and_output_dir(tmp_path, capsys):
+    cfg = tmp_path / "config.toml"
+    cfg.write_text('[runtime]\nname = "cli-edge"\n')
+    out_dir = tmp_path / "out"
+    rc = main(
+        [
+            "render",
+            "--set", "nameOverride=cli-edge",
+            "--set", "tpuRuntimeEnableExternalSsh=false",
+            "--set-file", f"jaxRuntimeConfig={cfg}",
+            "--output-dir", str(out_dir),
+        ]
+    )
+    assert rc == 0
+    files = sorted(p.name for p in out_dir.iterdir())
+    assert files == [
+        "jax-tpu-boot-config-secret.yaml",
+        "jax-tpu-runtime-config-secret.yaml",
+        "jax-tpu-runtime.yaml",
+        "jax-tpu-state-volume.yaml",
+    ]
+    dep = yaml.safe_load((out_dir / "jax-tpu-runtime.yaml").read_text())
+    assert dep["metadata"]["name"] == "cli-edge-runtime"
+
+
+def test_bad_value_is_error_not_traceback(capsys):
+    assert main(["render", "--set", "tpuRuntimeDiskSize=bogus"]) == 1
+    assert "error:" in capsys.readouterr().err
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    assert "kvedge-tpu 0.1.0" in capsys.readouterr().out

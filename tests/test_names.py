@@ -1,0 +1,56 @@
+"""Name-helper semantics, incl. the reference's trunc-40/trimSuffix rule."""
+
+from kvedge_tpu.render.names import resource_name, common_labels
+from kvedge_tpu.render.manifests import boot_config_secret, runtime_deployment
+from kvedge_tpu.config.values import ChartValues
+from kvedge_tpu.version import CHART_NAME
+
+
+def test_default_is_chart_name():
+    assert resource_name("") == CHART_NAME
+    assert resource_name() == CHART_NAME
+
+
+def test_override_wins():
+    assert resource_name("my-edge") == "my-edge"
+
+
+def test_trunc_40_then_trim_dash():
+    # 39 chars + '-' + more: truncation at 40 leaves a trailing '-' that must
+    # be trimmed (reference _helper.tpl:7: `trunc 40 | trimSuffix "-"`).
+    long = "a" * 39 + "-tail"
+    assert resource_name(long) == "a" * 39
+    assert len(resource_name("x" * 64)) == 40
+
+
+def test_labels_shape():
+    labels = common_labels()
+    assert labels["app.kubernetes.io/managed-by"] == "Helm"
+    assert "app.kubernetes.io/version" in labels
+    # The chart-name label is commented out in the reference (_helper.tpl:21)
+    # and intentionally absent here.
+    assert "helm.sh/chart" not in labels
+
+
+def test_boot_secret_name_matches_deployment_ref_when_override_empty():
+    """Regression for the reference's latent naming bug.
+
+    The reference refs its cloud-init Secret via raw `.Values.nameOverride`
+    (aziot-edge-vm.yaml:57, live TODO): with nameOverride unset the VM and
+    Secret names diverge. kvedge-tpu routes both through the name helper;
+    pin that they agree exactly in the empty-override case.
+    """
+    values = ChartValues(nameOverride="")
+    secret_name = boot_config_secret(values)["metadata"]["name"]
+    dep = runtime_deployment(values)
+    vols = dep["spec"]["template"]["spec"]["volumes"]
+    ref = next(v for v in vols if v["name"] == "bootconfigdisk")
+    assert ref["secret"]["secretName"] == secret_name
+    assert secret_name == f"{CHART_NAME}-runtime-bootconfig"
+
+
+def test_trim_suffix_strips_at_most_one_dash():
+    # sprig `trimSuffix "-"` removes one dash, not all — byte-parity with
+    # the Helm chart depends on this.
+    name = "a" * 38 + "--tail"
+    assert resource_name(name) == "a" * 38 + "-"

@@ -1,0 +1,168 @@
+"""Structural render tests — the `helm template` snapshot analogue."""
+
+import base64
+
+import yaml
+
+import pytest
+
+from kvedge_tpu.config.values import ChartValues, DEFAULT_VALUES
+from kvedge_tpu.render import render_all, to_yaml, to_multidoc_yaml
+from kvedge_tpu.render import bootconfig
+from kvedge_tpu.render.manifests import render_notes
+
+
+def _decode(secret, key="userdata"):
+    return base64.b64decode(secret["data"][key]).decode("utf-8")
+
+
+def test_default_render_has_five_manifests():
+    # Mirrors the reference's rendered set: VM, DataVolume, 2 Secrets,
+    # Service (SURVEY.md §1 L2) — here Deployment, PVC, 2 Secrets, Service.
+    chart = render_all(DEFAULT_VALUES)
+    assert set(chart.manifests) == {
+        "jax-tpu-runtime.yaml",
+        "jax-tpu-state-volume.yaml",
+        "jax-tpu-runtime-config-secret.yaml",
+        "jax-tpu-boot-config-secret.yaml",
+        "jax-tpu-runtime-service.yaml",
+    }
+
+
+def test_ssh_gate_drops_service():
+    chart = render_all(
+        DEFAULT_VALUES.replace(tpuRuntimeEnableExternalSsh=False)
+    )
+    assert "jax-tpu-runtime-service.yaml" not in chart.manifests
+    assert len(chart.manifests) == 4
+
+
+def test_dead_template_excluded_by_default_and_collides_if_included():
+    # Reference quirk carried: the alternative volume template renders the
+    # SAME resource name and only the packaging exclusion prevents the
+    # collision (.helmignore:23-24, SURVEY.md §2 #6).
+    chart = render_all(DEFAULT_VALUES)
+    assert "jax-tpu-state-volume-prepopulated.yaml" not in chart.manifests
+    full = render_all(DEFAULT_VALUES, include_dead=True)
+    live = full.manifests["jax-tpu-state-volume.yaml"]
+    dead = full.manifests["jax-tpu-state-volume-prepopulated.yaml"]
+    assert live["metadata"]["name"] == dead["metadata"]["name"]
+    assert "dataSourceRef" in dead["spec"]
+
+
+def test_config_secret_roundtrip():
+    toml = '[runtime]\nname = "edge-b"\n'
+    chart = render_all(DEFAULT_VALUES.replace(jaxRuntimeConfig=toml))
+    secret = chart.manifests["jax-tpu-runtime-config-secret.yaml"]
+    assert _decode(secret) == toml
+
+
+def test_boot_config_document_contents():
+    values = DEFAULT_VALUES.replace(publicSshKey="ssh-ed25519 KEY me@host")
+    chart = render_all(values)
+    doc = _decode(chart.manifests["jax-tpu-boot-config-secret.yaml"])
+    assert doc.startswith(bootconfig.HEADER)
+    assert "ssh-ed25519 KEY me@host" in doc
+    assert bootconfig.CONFIG_SERIAL in doc
+    # bootcmd locates the config volume by serial before runcmd applies it
+    # (ordering mirrors _helper.tpl:61-74).
+    assert doc.index("bootcmd:") < doc.index("runcmd:")
+    parsed = yaml.safe_load(doc)
+    assert parsed["hostname"] == bootconfig.RUNTIME_HOSTNAME
+    assert len(parsed["runcmd"]) == 2
+
+
+def test_deployment_wiring():
+    chart = render_all(DEFAULT_VALUES)
+    dep = chart.manifests["jax-tpu-runtime.yaml"]
+    spec = dep["spec"]
+    assert spec["replicas"] == 1
+    assert spec["strategy"] == {"type": "Recreate"}
+    pod = spec["template"]["spec"]
+    # Volume refs resolve to rendered resources.
+    names = {
+        m["metadata"]["name"] for m in chart.manifests.values()
+    }
+    for vol in pod["volumes"]:
+        if "secret" in vol:
+            assert vol["secret"]["secretName"] in names
+        if "persistentVolumeClaim" in vol:
+            assert vol["persistentVolumeClaim"]["claimName"] in names
+    # Service selector matches pod labels.
+    svc = chart.manifests["jax-tpu-runtime-service.yaml"]
+    selector = svc["spec"]["selector"]
+    pod_labels = spec["template"]["metadata"]["labels"]
+    assert selector.items() <= pod_labels.items()
+    assert spec["selector"]["matchLabels"].items() <= pod_labels.items()
+    # TPU node selector uses the accelerator value.
+    assert (
+        pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+        == DEFAULT_VALUES.tpuAccelerator
+    )
+    # Config secret is mounted under the serial-tagged path the boot
+    # document tells the bootstrap to search for.
+    mounts = pod["containers"][0]["volumeMounts"]
+    cfg_mount = next(m for m in mounts if m["name"] == "jaxconfigdisk")
+    assert cfg_mount["mountPath"].endswith(bootconfig.CONFIG_SERIAL)
+
+
+def test_disk_size_flows_to_pvc():
+    chart = render_all(DEFAULT_VALUES.replace(tpuRuntimeDiskSize="32Gi"))
+    pvc = chart.manifests["jax-tpu-state-volume.yaml"]
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "32Gi"
+
+
+def test_notes_mention_resources():
+    notes = render_notes(DEFAULT_VALUES)
+    name = "kvedge-tpu"
+    assert f"kubectl get deployment {name}-runtime" in notes
+    assert f"{name}-runtime-ssh-service" in notes
+
+
+def test_yaml_emission_stable_and_parseable():
+    chart = render_all(DEFAULT_VALUES)
+    stream = to_multidoc_yaml([doc for _, doc in chart.ordered()])
+    parsed = list(yaml.safe_load_all(stream))
+    assert len(parsed) == 5
+    assert to_yaml(chart.manifests["jax-tpu-runtime.yaml"]) == to_yaml(
+        chart.manifests["jax-tpu-runtime.yaml"]
+    )
+
+
+def test_invalid_values_rejected_at_render():
+    with pytest.raises(ValueError):
+        render_all(ChartValues(tpuRuntimeDiskSize="bogus"))
+
+
+def test_ssh_key_yaml_safe():
+    # Empty key must stay a string (not YAML null); tricky keys must not
+    # corrupt the document structure.
+    doc = _decode(
+        render_all(DEFAULT_VALUES).manifests["jax-tpu-boot-config-secret.yaml"]
+    )
+    assert yaml.safe_load(doc)["ssh_authorized_keys"] == [""]
+    tricky = 'ssh-ed25519 AAAA user: laptop #1'
+    doc = _decode(
+        render_all(DEFAULT_VALUES.replace(publicSshKey=tricky)).manifests[
+            "jax-tpu-boot-config-secret.yaml"
+        ]
+    )
+    assert yaml.safe_load(doc)["ssh_authorized_keys"] == [tricky]
+
+
+def test_status_port_follows_runtime_config():
+    toml = "[status]\nport = 9000\n"
+    chart = render_all(DEFAULT_VALUES.replace(jaxRuntimeConfig=toml))
+    dep = chart.manifests["jax-tpu-runtime.yaml"]
+    ports = dep["spec"]["template"]["spec"]["containers"][0]["ports"]
+    assert {"containerPort": 9000, "name": "status"} in ports
+    svc = chart.manifests["jax-tpu-runtime-service.yaml"]
+    status = next(p for p in svc["spec"]["ports"] if p["name"] == "status")
+    assert status["port"] == 9000 and status["targetPort"] == 9000
+
+
+def test_bad_runtime_config_fails_at_render():
+    # Install-time validation: the reference only surfaced a bad config.toml
+    # inside the booted VM; here it fails the render/install command.
+    with pytest.raises(ValueError):
+        render_all(DEFAULT_VALUES.replace(jaxRuntimeConfig="not [valid"))

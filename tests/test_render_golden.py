@@ -1,0 +1,32 @@
+"""Golden-file snapshots of the default render.
+
+The analogue of `helm template` snapshot testing (SURVEY.md §4 implication).
+Regenerate after an intentional template change with:
+
+    python -m kvedge_tpu render --golden tests/golden/default
+"""
+
+import pathlib
+
+from kvedge_tpu.config.values import DEFAULT_VALUES
+from kvedge_tpu.render import render_all, to_yaml
+from kvedge_tpu.render.manifests import render_notes
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden" / "default"
+
+
+def test_golden_filenames():
+    chart = render_all(DEFAULT_VALUES)
+    expected = {p.name for p in GOLDEN_DIR.glob("*.yaml")}
+    assert set(chart.manifests) == expected
+
+
+def test_golden_bytes():
+    chart = render_all(DEFAULT_VALUES)
+    for filename, doc in chart.ordered():
+        golden = (GOLDEN_DIR / filename).read_text()
+        assert to_yaml(doc) == golden, f"golden mismatch: {filename}"
+
+
+def test_golden_notes():
+    assert render_notes(DEFAULT_VALUES) == (GOLDEN_DIR / "NOTES.txt").read_text()

@@ -1,0 +1,112 @@
+"""Runtime-config TOML: parse, validate, round-trip, apply."""
+
+import pytest
+
+from kvedge_tpu.config.runtime_config import (
+    MeshSpec,
+    RuntimeConfig,
+    RuntimeConfigError,
+)
+
+SAMPLE = """
+[runtime]
+name = "edge-tpu-a"
+state_dir = "/var/lib/kvedge/state"
+heartbeat_interval_s = 5.0
+
+[tpu]
+platform = "tpu"
+expected_chips = 8
+
+[mesh]
+axes = { data = 2, model = 4 }
+
+[status]
+port = 9000
+
+[payload]
+kind = "transformer-probe"
+"""
+
+
+def test_parse_sample():
+    cfg = RuntimeConfig.parse(SAMPLE)
+    assert cfg.name == "edge-tpu-a"
+    assert cfg.expected_chips == 8
+    assert cfg.mesh.axes == (("data", 2), ("model", 4))
+    assert cfg.status_port == 9000
+    assert cfg.payload == "transformer-probe"
+
+
+def test_defaults_from_empty_doc():
+    cfg = RuntimeConfig.parse("")
+    assert cfg.payload == "devicecheck"
+    assert cfg.mesh.axis_names() == ("data", "model")
+    assert cfg.expected_chips == 0
+
+
+def test_invalid_toml_and_values():
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse("not [valid toml")
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse("[payload]\nkind = 'mine-bitcoin'\n")
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse("[status]\nport = 99999\n")
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse("[runtime]\nheartbeat_interval_s = 0\n")
+
+
+def test_mesh_resolution():
+    spec = MeshSpec(axes=(("data", 0), ("model", 4)))
+    assert spec.resolved_shape(8) == (2, 4)
+    with pytest.raises(RuntimeConfigError):
+        spec.resolved_shape(6)  # 6 % 4 != 0
+    fixed = MeshSpec(axes=(("data", 2), ("model", 4)))
+    assert fixed.resolved_shape(8) == (2, 4)
+    with pytest.raises(RuntimeConfigError):
+        fixed.resolved_shape(16)
+    with pytest.raises(RuntimeConfigError):
+        MeshSpec(axes=(("a", 0), ("b", 0))).resolved_shape(8)
+
+
+def test_round_trip_and_apply(tmp_path):
+    cfg = RuntimeConfig.parse(SAMPLE)
+    # to_toml -> parse is the identity on the validated form.
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    target = tmp_path / "etc" / "config.toml"
+    state = tmp_path / "state"
+    cfg2 = RuntimeConfig.parse(
+        cfg.to_toml().replace("/var/lib/kvedge/state", str(state))
+    )
+    written = cfg2.apply(config_path=str(target))
+    assert written == str(target)
+    assert state.is_dir()
+    assert RuntimeConfig.parse(target.read_text()) == cfg2
+
+
+def test_to_toml_escapes_strings():
+    # Quotes and backslashes in values must survive apply -> re-parse
+    # (the applied config is what the next boot reads).
+    cfg = RuntimeConfig(name='a"b\\c', state_dir="C:\\kvedge state")
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+
+
+def test_validate_catches_programmatic_bad_mesh():
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig(mesh=MeshSpec(axes=())).validate()
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig(mesh=MeshSpec(axes=(("a", -1),))).validate()
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig(mesh=MeshSpec(axes=(("a", 1), ("a", 2)))).validate()
+
+
+def test_two_zero_axes_rejected_at_parse():
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse("[mesh]\naxes = { data = 0, model = 0 }\n")
+
+
+def test_wrongly_typed_values_raise_config_error():
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse('[status]\nport = "abc"\n')
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse('[runtime]\nheartbeat_interval_s = "fast"\n')

@@ -1,0 +1,68 @@
+"""Chart-values surface: defaults, validation, --set/--set-file parsing."""
+
+import dataclasses
+
+import pytest
+
+from kvedge_tpu.config.values import (
+    ChartValues,
+    DEFAULT_VALUES,
+    parse_set_flag,
+    parse_set_file_flag,
+)
+
+
+def test_exactly_six_values():
+    # The reference's config surface is exactly six values (values.yaml:1-17);
+    # parity check against SURVEY.md §2 #2.
+    assert len(dataclasses.fields(ChartValues)) == 6
+
+
+def test_defaults_mirror_reference():
+    v = DEFAULT_VALUES
+    assert v.tpuRuntimeDiskSize == "4Gi"  # aziotEdgeVmDiskSize: 4Gi
+    assert v.tpuRuntimeEnableExternalSsh is True
+    assert v.publicSshKey == ""
+    assert v.jaxRuntimeConfig == ""
+
+
+def test_disk_size_validation():
+    with pytest.raises(ValueError):
+        ChartValues(tpuRuntimeDiskSize="four gigs").validate()
+    ChartValues(tpuRuntimeDiskSize="100Mi").validate()
+    ChartValues(tpuRuntimeDiskSize="2Ti").validate()
+
+
+def test_accelerator_validation():
+    with pytest.raises(ValueError):
+        ChartValues(tpuAccelerator="Not Valid!").validate()
+    ChartValues(tpuAccelerator="tpu-v6e-slice").validate()
+
+
+def test_set_flag_bool_and_string():
+    v = parse_set_flag(DEFAULT_VALUES, "tpuRuntimeEnableExternalSsh=false")
+    assert v.tpuRuntimeEnableExternalSsh is False
+    v = parse_set_flag(v, "publicSshKey=ssh-rsa AAAA... me@host")
+    assert v.publicSshKey.startswith("ssh-rsa")
+    with pytest.raises(ValueError):
+        parse_set_flag(v, "noSuchValue=1")
+    with pytest.raises(ValueError):
+        parse_set_flag(v, "tpuRuntimeEnableExternalSsh=maybe")
+    with pytest.raises(ValueError):
+        parse_set_flag(v, "malformed")
+
+
+def test_set_file_flag(tmp_path):
+    cfg = tmp_path / "config.toml"
+    cfg.write_text('[runtime]\nname = "edge-a"\n')
+    v = parse_set_file_flag(DEFAULT_VALUES, f"jaxRuntimeConfig={cfg}")
+    assert 'name = "edge-a"' in v.jaxRuntimeConfig
+    with pytest.raises(ValueError):
+        parse_set_file_flag(v, f"tpuRuntimeEnableExternalSsh={cfg}")
+
+
+def test_name_override_validated_rfc1123():
+    with pytest.raises(ValueError):
+        ChartValues(nameOverride="Bad_Name!").validate()
+    ChartValues(nameOverride="").validate()  # empty = fall back to chart name
+    ChartValues(nameOverride="my-edge-2").validate()
