@@ -1,0 +1,17 @@
+"""Boot-time bootstrap: the cloud-init analogue.
+
+The reference's guest bootstrap (SURVEY.md §1 L4) is cloud-init executing the
+rendered user-data: mount the serial-tagged config disk (``_helper.tpl:61-64``),
+install the runtime, copy the injected config into place, and apply it
+(``_helper.tpl:68-74``). kvedge-tpu's bootstrap is the container entrypoint
+executing the rendered ``#kvedge-boot-config`` document the same way:
+
+* :mod:`kvedge_tpu.bootstrap.bootdoc` — parse the boot-config document;
+* :mod:`kvedge_tpu.bootstrap.mount` — locate the config volume by serial
+  (the ``lsblk | grep <serial>`` analogue);
+* :mod:`kvedge_tpu.bootstrap.commands` — the in-process ``kvedge-bootstrap``
+  / ``kvedge-runtime`` command handlers bootcmd/runcmd dispatch to;
+* :mod:`kvedge_tpu.bootstrap.entrypoint` — PID-1 sequencing: parse document,
+  authorize SSH keys, run ``bootcmd`` then ``runcmd`` in order (the ordering
+  guarantee the reference calls out at ``_helper.tpl:67``).
+"""

@@ -47,7 +47,8 @@ class MeshSpec:
     (at most one axis may be zero).
     """
 
-    axes: tuple[tuple[str, int], ...] = (("data", 1), ("model", 1))
+    # Default: all visible devices on the data axis (0 = inferred).
+    axes: tuple[tuple[str, int], ...] = (("data", 0), ("model", 1))
 
     def validate(self) -> None:
         if not self.axes:
@@ -121,7 +122,7 @@ class RuntimeConfig:
         status = dict(doc.get("status", {}))
         payload_doc = dict(doc.get("payload", {}))
 
-        axes_doc = mesh_doc.get("axes", {"data": 1, "model": 1})
+        axes_doc = mesh_doc.get("axes", dict(MeshSpec.axes))
         if not isinstance(axes_doc, Mapping):
             raise RuntimeConfigError("[mesh] axes must be a table")
         axes = [(str(axis), size) for axis, size in axes_doc.items()]
@@ -154,7 +155,8 @@ class RuntimeConfig:
             raise RuntimeConfigError("[runtime] heartbeat_interval_s must be > 0")
         if self.expected_chips < 0:
             raise RuntimeConfigError("[tpu] expected_chips must be >= 0")
-        if not (0 < self.status_port < 65536):
+        # Port 0 = bind an ephemeral port (tests / local verification).
+        if not (0 <= self.status_port < 65536):
             raise RuntimeConfigError("[status] port out of range")
         if self.payload not in _VALID_PAYLOADS:
             raise RuntimeConfigError(

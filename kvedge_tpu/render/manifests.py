@@ -73,7 +73,13 @@ def status_port(values: ChartValues) -> int:
     """
     if not values.jaxRuntimeConfig:
         return STATUS_PORT
-    return RuntimeConfig.parse(values.jaxRuntimeConfig).status_port
+    port = RuntimeConfig.parse(values.jaxRuntimeConfig).status_port
+    if port == 0:
+        raise ValueError(
+            "[status] port 0 (ephemeral) is only valid for local runs; "
+            "manifests need a concrete port to expose"
+        )
+    return port
 
 
 def _b64(text: str) -> str:

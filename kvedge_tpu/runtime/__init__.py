@@ -1,0 +1,20 @@
+"""The JAX TPU runtime — the payload the accelerator provisions.
+
+The reference's payload is the externally-installed Azure IoT Edge daemon:
+after cloud-init applies the injected config, ``iotedge config apply``
+starts a runtime that connects out and brokers messages, persisting state to
+the PVC-backed disk (``README.md:88``). Nothing in the reference repo
+executes after boot — the runtime is the capability being *hosted*.
+
+kvedge-tpu's hosted runtime is JAX-native (SURVEY.md §7 step 4's minimum
+end-to-end slice, widened):
+
+* :mod:`kvedge_tpu.runtime.devicecheck` — TPU visibility probe + a pjit'd
+  matmul across the configured device mesh;
+* :mod:`kvedge_tpu.runtime.heartbeat` — durable heartbeat records in the
+  PVC-backed state dir (the persistence-across-rescheduling proof);
+* :mod:`kvedge_tpu.runtime.status` — the HTTP status endpoint exposed by
+  the access Service (the ``kubectl get vmi`` / ssh-smoke analogue);
+* :mod:`kvedge_tpu.runtime.boot` — orchestration: config -> payload ->
+  heartbeat loop + status server.
+"""

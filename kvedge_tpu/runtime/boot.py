@@ -1,0 +1,140 @@
+"""Runtime boot orchestration: applied config -> payload -> heartbeat + status.
+
+This is what ``kvedge-runtime boot`` (the final ``runcmd`` of the boot
+document) executes — the analogue of the IoT Edge daemon starting after
+``iotedge config apply`` (``_helper.tpl:74``). In a real pod it never
+returns; ``once=True`` performs a single heartbeat cycle for tests and
+local verification.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from kvedge_tpu.config.runtime_config import RuntimeConfig
+from kvedge_tpu.runtime import heartbeat
+from kvedge_tpu.runtime.devicecheck import DeviceCheckResult, run_device_check
+from kvedge_tpu.runtime.status import StatusServer
+
+
+@dataclasses.dataclass
+class RuntimeHandle:
+    """A started runtime: payload result, heartbeat writer, status server."""
+
+    cfg: RuntimeConfig
+    check: DeviceCheckResult
+    writer: heartbeat.HeartbeatWriter
+    server: StatusServer
+    boot_count: int
+    started_at: float
+
+    @property
+    def status_port(self) -> int:
+        return self.server.port
+
+    def snapshot(self) -> dict:
+        last = heartbeat.read_heartbeat(self.cfg.state_dir) or {}
+        return {
+            "name": self.cfg.name,
+            "ok": self.check.ok,
+            "payload": self.cfg.payload,
+            "check": self.check.to_dict(),
+            "boot_count": self.boot_count,
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "heartbeat_seq": last.get("seq", 0),
+            "heartbeat_age_s": (
+                round(time.time() - last["ts"], 3) if "ts" in last else None
+            ),
+        }
+
+    def shutdown(self) -> None:
+        self.writer.stop()
+        self.server.shutdown()
+
+
+def _run_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
+    if cfg.payload == "none":
+        return DeviceCheckResult(
+            ok=True, platform="skipped", device_count=0, device_kinds=(),
+            mesh_axes=(), mesh_shape=(), probe_ms=0.0, probe_checksum=0.0,
+        )
+    try:
+        if cfg.payload == "transformer-probe":
+            from kvedge_tpu.runtime.workload import run_transformer_probe
+
+            return run_transformer_probe(cfg)
+        return run_device_check(cfg)
+    except Exception as e:
+        # A payload failure must leave the pod serving /status (degraded,
+        # debuggable from outside — like ssh-ing into a VM whose payload
+        # daemon failed), never crash-looping with a raw traceback.
+        return DeviceCheckResult(
+            ok=False, platform="unknown", device_count=0, device_kinds=(),
+            mesh_axes=(), mesh_shape=(), probe_ms=0.0, probe_checksum=0.0,
+            error=f"payload {cfg.payload!r} failed: {e!r}",
+        )
+
+
+def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
+    """Run the payload once, then start the heartbeat loop + status server."""
+    started_at = time.time()
+    boot_count = heartbeat.next_boot_count(cfg.state_dir)
+    check = _run_payload(cfg)
+
+    handle: RuntimeHandle = None  # assigned below; closures capture it
+
+    def build_heartbeat() -> dict:
+        return {
+            "name": cfg.name,
+            "ok": check.ok,
+            "payload": cfg.payload,
+            "boot_count": boot_count,
+            "check": check.to_dict(),
+        }
+
+    writer = heartbeat.HeartbeatWriter(
+        cfg.state_dir, cfg.heartbeat_interval_s, build_heartbeat
+    )
+    server = StatusServer(
+        cfg.status_bind, cfg.status_port,
+        snapshot=lambda: handle.snapshot(),
+        healthy=lambda: check.ok,
+    )
+    handle = RuntimeHandle(
+        cfg=cfg, check=check, writer=writer, server=server,
+        boot_count=boot_count, started_at=started_at,
+    )
+    writer.beat_once()  # heartbeat visible before the server answers
+    server.start()
+    return handle
+
+
+def boot(config_path: str, once: bool = False, root: str = "/") -> None:
+    """Entry for ``kvedge-runtime boot --config <path>``.
+
+    ``root`` is accepted for signature symmetry with the other boot
+    commands; paths inside the config were already rebased when
+    ``kvedge-bootstrap apply`` wrote it.
+    """
+    del root
+    with open(config_path, "r", encoding="utf-8") as fh:
+        cfg = RuntimeConfig.parse(fh.read())
+    handle = start_runtime(cfg)
+    print(
+        f"[kvedge-runtime] {cfg.name}: payload={cfg.payload} "
+        f"ok={handle.check.ok} devices={handle.check.device_count} "
+        f"status=:{handle.status_port} boot_count={handle.boot_count}",
+        flush=True,
+    )
+    if not handle.check.ok:
+        # Degraded: keep serving /status (debuggable from outside, like
+        # ssh-ing into a VM whose payload failed), but say so loudly.
+        print(f"[kvedge-runtime] DEGRADED: {handle.check.error}", flush=True)
+    if once:
+        handle.shutdown()
+        return
+    try:
+        handle.writer.run()  # heartbeat loop on the main thread, forever
+    finally:
+        handle.shutdown()

@@ -1,0 +1,121 @@
+"""Device-visibility check and sharded matmul probe.
+
+The end-to-end "it works" signal for the provisioned runtime (SURVEY.md §7
+step 4): the analogue of the reference's post-install smoke test — the VM
+boots and `kubectl get vmi` shows Running (`NOTES.txt:9`) — is that the pod
+sees its TPU chips and can execute one compiled, mesh-sharded computation
+across all of them.
+
+TPU-first details: the probe is a bf16 matmul (MXU-shaped work, not a toy
+scalar op), laid out over the configured `jax.sharding.Mesh` with the batch
+dim sharded across every mesh axis, so a wrong sharding or a missing chip
+fails loudly here rather than in a real workload later.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Sequence
+
+from kvedge_tpu.config.runtime_config import RuntimeConfig
+
+PROBE_ROWS_PER_DEVICE = 16
+PROBE_DIM = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceCheckResult:
+    ok: bool
+    platform: str
+    device_count: int
+    device_kinds: tuple[str, ...]
+    mesh_axes: tuple[str, ...]
+    mesh_shape: tuple[int, ...]
+    probe_ms: float
+    probe_checksum: float
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self) | {
+            "device_kinds": list(self.device_kinds),
+            "mesh_axes": list(self.mesh_axes),
+            "mesh_shape": list(self.mesh_shape),
+        }
+
+
+def _failure(platform: str, count: int, kinds: Sequence[str], error: str
+             ) -> DeviceCheckResult:
+    return DeviceCheckResult(
+        ok=False, platform=platform, device_count=count,
+        device_kinds=tuple(kinds), mesh_axes=(), mesh_shape=(),
+        probe_ms=0.0, probe_checksum=0.0, error=error,
+    )
+
+
+def run_device_check(cfg: RuntimeConfig) -> DeviceCheckResult:
+    """Probe device visibility, then run one pjit'd matmul over the mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    platform = devices[0].platform if devices else "none"
+    kinds = tuple(sorted({d.device_kind for d in devices}))
+    count = len(devices)
+
+    if cfg.expected_platform and platform != cfg.expected_platform:
+        return _failure(
+            platform, count, kinds,
+            f"expected platform {cfg.expected_platform!r}, got {platform!r}",
+        )
+    if cfg.expected_chips and count != cfg.expected_chips:
+        return _failure(
+            platform, count, kinds,
+            f"expected {cfg.expected_chips} chips, {count} visible",
+        )
+
+    try:
+        shape = cfg.mesh.resolved_shape(count)
+    except Exception as e:
+        return _failure(platform, count, kinds, f"mesh resolution failed: {e}")
+
+    axis_names = cfg.mesh.axis_names()
+    mesh = Mesh(mesh_utils.create_device_mesh(shape, devices=devices),
+                axis_names)
+
+    rows = PROBE_ROWS_PER_DEVICE * count
+    x_sharding = NamedSharding(mesh, P(axis_names))  # batch over all axes
+    w_sharding = NamedSharding(mesh, P())            # replicated weights
+
+    @jax.jit
+    def probe(x, w):
+        return jnp.sum(x @ w)
+
+    try:
+        x = jax.device_put(
+            jnp.ones((rows, PROBE_DIM), dtype=jnp.bfloat16), x_sharding
+        )
+        w = jax.device_put(
+            jnp.full((PROBE_DIM, PROBE_DIM), 0.5, dtype=jnp.bfloat16),
+            w_sharding,
+        )
+        start = time.perf_counter()
+        checksum = float(probe(x, w).block_until_ready())
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+    except Exception as e:  # XLA failures surface as runtime errors
+        return _failure(platform, count, kinds, f"matmul probe failed: {e}")
+
+    expected = rows * PROBE_DIM * PROBE_DIM * 0.5
+    if abs(checksum - expected) > expected * 1e-2:
+        return _failure(
+            platform, count, kinds,
+            f"probe checksum {checksum} != expected {expected}",
+        )
+
+    return DeviceCheckResult(
+        ok=True, platform=platform, device_count=count, device_kinds=kinds,
+        mesh_axes=axis_names, mesh_shape=shape,
+        probe_ms=elapsed_ms, probe_checksum=checksum,
+    )

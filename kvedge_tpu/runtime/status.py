@@ -1,0 +1,75 @@
+"""HTTP status endpoint — the runtime's externally reachable smoke surface.
+
+The reference's post-install verification is human: ``kubectl get vmi``
+shows Running, then ssh in (``NOTES.txt:8-12``). kvedge-tpu adds a machine
+surface behind the same LoadBalancer: ``/healthz`` for probes, ``/status``
+for the full runtime picture (devices, mesh, heartbeat age, boot count).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from kvedge_tpu.version import __version__
+
+
+class StatusServer:
+    """Threaded HTTP server.
+
+    ``snapshot`` supplies the /status document; ``healthy`` is a cheap
+    in-memory check for /healthz (liveness probes hit it every few seconds,
+    so it must not touch the state volume).
+    """
+
+    def __init__(self, bind: str, port: int, snapshot: Callable[[], dict],
+                 healthy: Callable[[], bool] | None = None):
+        outer = self
+        self._healthy = healthy or (
+            lambda: bool(snapshot().get("ok", False))
+        )
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _send(self, code: int, doc: dict) -> None:
+                body = json.dumps(doc, indent=2, sort_keys=True).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    healthy = outer._healthy()
+                    self._send(200 if healthy else 503,
+                               {"status": "ok" if healthy else "degraded"})
+                elif self.path == "/status":
+                    self._send(200, outer._snapshot())
+                elif self.path == "/version":
+                    self._send(200, {"version": __version__})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+        self._snapshot = snapshot
+        self._server = ThreadingHTTPServer((bind, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="kvedge-status",
+            daemon=True,
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_port
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,117 @@
+"""Bootstrap pipeline: boot-doc parsing, serial discovery, config apply."""
+
+import os
+
+import pytest
+
+from kvedge_tpu.bootstrap.bootdoc import BootDocError, parse_boot_document
+from kvedge_tpu.bootstrap import mount
+from kvedge_tpu.bootstrap.commands import CommandError, rebase, run_command
+from kvedge_tpu.config.values import DEFAULT_VALUES
+from kvedge_tpu.render import bootconfig
+from kvedge_tpu.render.bootconfig import boot_config_document
+
+
+def test_parse_rendered_document_roundtrip():
+    values = DEFAULT_VALUES.replace(publicSshKey="ssh-ed25519 KEY me@host")
+    doc = parse_boot_document(boot_config_document(values))
+    assert doc.hostname == bootconfig.RUNTIME_HOSTNAME
+    assert doc.ssh_authorized_keys == ("ssh-ed25519 KEY me@host",)
+    assert doc.bootcmd[0][:2] == ("kvedge-bootstrap", "locate")
+    assert doc.runcmd[0][:2] == ("kvedge-bootstrap", "apply")
+    assert doc.runcmd[1][:2] == ("kvedge-runtime", "boot")
+
+
+def test_empty_ssh_key_not_authorized():
+    doc = parse_boot_document(boot_config_document(DEFAULT_VALUES))
+    assert doc.ssh_authorized_keys == ()
+
+
+def test_header_sentinel_required():
+    with pytest.raises(BootDocError):
+        parse_boot_document("#cloud-config\nhostname: nope\n")
+    with pytest.raises(BootDocError):
+        parse_boot_document("")
+
+
+def test_malformed_commands_rejected():
+    base = f"{bootconfig.HEADER}\nhostname: h\n"
+    with pytest.raises(BootDocError):
+        parse_boot_document(base + "bootcmd: notalist\n")
+    with pytest.raises(BootDocError):
+        parse_boot_document(base + "runcmd:\n  - [1, 2]\n")
+    with pytest.raises(BootDocError):
+        parse_boot_document(base + 'runcmd:\n  - ""\n')
+
+
+def test_locate_by_serial(tmp_path):
+    disks = tmp_path / "mnt" / "disks"
+    vol = disks / bootconfig.CONFIG_SERIAL
+    vol.mkdir(parents=True)
+    (vol / "userdata").write_text("[runtime]\n")
+    link = tmp_path / "mnt" / "app-secret"
+    found = mount.locate(bootconfig.CONFIG_SERIAL, str(disks), str(link))
+    assert found == str(vol)
+    assert (link / "userdata").read_text() == "[runtime]\n"
+    # Idempotent re-run (bootcmd reruns every boot).
+    assert mount.locate(
+        bootconfig.CONFIG_SERIAL, str(disks), str(link)
+    ) == str(vol)
+
+
+def test_locate_failures(tmp_path):
+    disks = tmp_path / "disks"
+    disks.mkdir()
+    with pytest.raises(mount.MountError, match="no volume with serial"):
+        mount.locate("NOPE123", str(disks), str(tmp_path / "link"))
+    # Serial dir exists but carries no userdata -> wrong Secret mounted.
+    (disks / "WRONGSECRET").mkdir()
+    with pytest.raises(mount.MountError, match="wrong Secret"):
+        mount.locate("WRONGSECRET", str(disks), str(tmp_path / "link"))
+
+
+def test_rebase():
+    assert rebase("/etc/kvedge/config.toml", "/") == "/etc/kvedge/config.toml"
+    assert rebase("/etc/x", "/tmp/root") == "/tmp/root/etc/x"
+
+
+def test_apply_command_rebases_state_dir(tmp_path):
+    root = str(tmp_path)
+    src = tmp_path / "userdata"
+    src.write_text(
+        '[runtime]\nstate_dir = "/var/lib/kvedge/state"\n'
+        '[tpu]\nplatform = "cpu"\n'
+    )
+    run_command(
+        ("kvedge-bootstrap", "apply", "--source", "/userdata",
+         "--target", "/etc/kvedge/config.toml"),
+        root=root,
+    )
+    applied = tmp_path / "etc" / "kvedge" / "config.toml"
+    text = applied.read_text()
+    assert str(tmp_path / "var/lib/kvedge/state") in text
+    assert (tmp_path / "var/lib/kvedge/state").is_dir()
+
+
+def test_apply_command_rejects_bad_config(tmp_path):
+    src = tmp_path / "userdata"
+    src.write_text("not [valid toml")
+    with pytest.raises(CommandError, match="invalid"):
+        run_command(
+            ("kvedge-bootstrap", "apply", "--source", "/userdata",
+             "--target", "/etc/kvedge/config.toml"),
+            root=str(tmp_path),
+        )
+
+
+def test_unknown_virtual_subcommand(tmp_path):
+    with pytest.raises(CommandError, match="subcommand"):
+        run_command(("kvedge-bootstrap", "frobnicate"), root=str(tmp_path))
+
+
+def test_subprocess_extension_command(tmp_path):
+    marker = tmp_path / "ran"
+    run_command(("touch", str(marker)), root=str(tmp_path))
+    assert marker.exists()
+    with pytest.raises(CommandError, match="exited with"):
+        run_command(("false",), root=str(tmp_path))

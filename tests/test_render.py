@@ -166,3 +166,8 @@ def test_bad_runtime_config_fails_at_render():
     # inside the booted VM; here it fails the render/install command.
     with pytest.raises(ValueError):
         render_all(DEFAULT_VALUES.replace(jaxRuntimeConfig="not [valid"))
+
+
+def test_ephemeral_status_port_rejected_at_render():
+    with pytest.raises(ValueError, match="port 0"):
+        render_all(DEFAULT_VALUES.replace(jaxRuntimeConfig="[status]\nport = 0\n"))
