@@ -1,0 +1,39 @@
+{{/*
+Expand the name of the chart.
+(Mirrors kvedge_tpu/render/names.py:resource_name — kept in lock-step by
+tests/test_chart_consistency.py.)
+*/}}
+{{- define "kvedgetpu.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 40 | trimSuffix "-" -}}
+{{- end -}}
+
+{{/*
+Common labels.
+(Mirrors kvedge_tpu/render/names.py:common_labels.)
+*/}}
+{{- define "kvedgetpu.labels" -}}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{/*
+The boot-config document for the runtime container — the cloud-init
+user-data analogue. Must stay byte-identical to
+kvedge_tpu/render/bootconfig.py:boot_config_document (the consistency test
+compares the decoded Secret payloads).
+*/}}
+{{- define "kvedgetpu.bootconfig" -}}
+#kvedge-boot-config
+hostname: kvedgetpuvm
+ssh_authorized_keys:
+  - {{ .Values.publicSshKey | toJson }}
+bootcmd:
+# locate the config Secret volume by serial and link it
+  - "kvedge-bootstrap locate --serial KV9TPU3EDGE7R412 --search-root /mnt/disks --link /mnt/app-secret"
+# Once the pod is started the following commands apply the injected
+# runtime config and boot the JAX runtime. The runtime image ships
+# with jax[tpu] preinstalled, so there is no package-install step.
+runcmd:
+  - "kvedge-bootstrap apply --source /mnt/app-secret/userdata --target /etc/kvedge/config.toml"
+  - "kvedge-runtime boot --config /etc/kvedge/config.toml"
+{{ end -}}

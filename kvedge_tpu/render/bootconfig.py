@@ -25,9 +25,8 @@ the reference.
 
 from __future__ import annotations
 
-import json
-
 from kvedge_tpu.config.values import ChartValues
+from kvedge_tpu.utils.gojson import go_json
 
 # The config-volume serial tag (analogue of D23YZ9W6WA5DJ487,
 # aziot-edge-vm.yaml:28). A fresh token — not the reference's.
@@ -54,12 +53,12 @@ def boot_config_document(values: ChartValues) -> str:
 
     Emitted as literal text (not via a YAML dumper) so the document is
     byte-stable for golden tests and for the Helm-chart consistency check.
-    The SSH key is JSON-quoted (valid YAML double-quoted scalar, matching
-    Helm's ``toJson``): an empty key stays a string instead of parsing as
-    YAML ``null``, and keys containing ``: `` or ``#`` can't corrupt the
-    document.
+    The SSH key is JSON-quoted with Go's escaping rules (valid YAML
+    double-quoted scalar, byte-matching Helm's ``toJson``): an empty key
+    stays a string instead of parsing as YAML ``null``, and keys containing
+    ``: `` or ``#`` can't corrupt the document.
     """
-    ssh_key = json.dumps(values.publicSshKey, ensure_ascii=True)
+    ssh_key = go_json(values.publicSshKey)
     return (
         f"{HEADER}\n"
         f"hostname: {RUNTIME_HOSTNAME}\n"

@@ -1,0 +1,5 @@
+"""Shared utilities."""
+
+from kvedge_tpu.utils.gojson import go_json
+
+__all__ = ["go_json"]

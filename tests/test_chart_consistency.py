@@ -1,0 +1,137 @@
+"""Prove the shipped Helm chart renders the same objects as the renderer.
+
+The chart (deployment/helm) is the L1 artifact real operators `helm install`;
+the Python renderer is what tests and the CLI exercise. This suite pins them
+together via helmlite, so template drift is a test failure, not a silent
+capability break.
+"""
+
+import base64
+import pathlib
+
+import pytest
+import yaml
+
+from kvedge_tpu.config.values import DEFAULT_VALUES
+from kvedge_tpu.render import render_all
+from kvedge_tpu.render.helmlite import Chart, HelmLiteError
+from kvedge_tpu.render.manifests import render_notes
+
+CHART_DIR = str(pathlib.Path(__file__).parent.parent / "deployment" / "helm")
+
+VALUE_MATRIX = [
+    {},
+    {"nameOverride": "my-edge", "publicSshKey": "ssh-ed25519 AAAA op@host"},
+    {"tpuRuntimeEnableExternalSsh": False, "tpuRuntimeDiskSize": "32Gi"},
+    {"jaxRuntimeConfig": '[runtime]\nname = "edge-x"\n',
+     "tpuAccelerator": "tpu-v6e-slice"},
+    # Empty nameOverride: the case where the reference's raw-.Values
+    # reference bit (aziot-edge-vm.yaml:57); both renderers must fall back
+    # to the chart name consistently.
+    {"nameOverride": ""},
+]
+
+
+@pytest.fixture(scope="module")
+def chart():
+    return Chart(CHART_DIR)
+
+
+@pytest.mark.parametrize("overrides", VALUE_MATRIX)
+def test_chart_matches_renderer(chart, overrides):
+    values = DEFAULT_VALUES.replace(**overrides)
+    expected = render_all(values)
+    rendered = chart.render(overrides)
+
+    helm_yaml = {n for n in rendered if n.endswith(".yaml")}
+    assert helm_yaml == set(expected.manifests), (
+        "chart and renderer disagree on which manifests exist"
+    )
+    for name in helm_yaml:
+        helm_doc = yaml.safe_load(rendered[name])
+        assert helm_doc == expected.manifests[name], f"drift in {name}"
+
+
+@pytest.mark.parametrize("overrides", VALUE_MATRIX)
+def test_boot_config_secret_byte_identical(chart, overrides):
+    values = DEFAULT_VALUES.replace(**overrides)
+    expected = render_all(values)
+    rendered = chart.render(overrides)
+    for name in ("jax-tpu-boot-config-secret.yaml",
+                 "jax-tpu-runtime-config-secret.yaml"):
+        helm_payload = base64.b64decode(
+            yaml.safe_load(rendered[name])["data"]["userdata"]
+        )
+        ours_payload = base64.b64decode(
+            expected.manifests[name]["data"]["userdata"]
+        )
+        assert helm_payload == ours_payload, f"secret payload drift in {name}"
+
+
+def test_notes_match(chart):
+    rendered = chart.render({})
+    assert rendered["NOTES.txt"] == render_notes(DEFAULT_VALUES)
+
+
+def test_dead_template_is_helmignored(chart):
+    # The prepopulated-volume alternative exists in the chart source but is
+    # excluded from packaging (the reference's .helmignore:23-24 quirk).
+    assert "jax-tpu-state-volume-prepopulated.yaml" in chart.ignored
+    assert "jax-tpu-state-volume-prepopulated.yaml" not in chart.templates
+    src = pathlib.Path(CHART_DIR, "templates",
+                       "jax-tpu-state-volume-prepopulated.yaml")
+    assert src.exists()
+
+
+def test_chart_metadata_matches_package():
+    from kvedge_tpu.version import (
+        APP_VERSION, CHART_NAME, CHART_VERSION, CHART_DESCRIPTION,
+    )
+
+    meta = yaml.safe_load(pathlib.Path(CHART_DIR, "Chart.yaml").read_text())
+    assert meta["name"] == CHART_NAME
+    assert str(meta["version"]) == CHART_VERSION
+    assert str(meta["appVersion"]) == APP_VERSION
+    assert meta["description"] == CHART_DESCRIPTION
+
+
+def test_helmlite_rejects_unknown_constructs(chart):
+    with pytest.raises(HelmLiteError):
+        chart._render_text("{{ .Values.noSuchValue }}",
+                           {"Values": dict(chart.default_values)})
+    with pytest.raises(HelmLiteError):
+        chart._render_text("{{ lookup \"v1\" \"Pod\" }}", {"Values": {}})
+
+
+def test_tojson_matches_go_html_escaping(chart):
+    # Helm's toJson (Go json.Marshal) escapes & < > — the ssh-key path must
+    # byte-match real helm, and both sides must agree.
+    overrides = {"publicSshKey": "ssh-ed25519 AAAA ops&infra<dev>@host"}
+    rendered = chart.render(overrides)
+    expected = render_all(DEFAULT_VALUES.replace(**overrides))
+    helm_payload = base64.b64decode(
+        yaml.safe_load(rendered["jax-tpu-boot-config-secret.yaml"])["data"][
+            "userdata"
+        ]
+    ).decode()
+    ours_payload = base64.b64decode(
+        expected.manifests["jax-tpu-boot-config-secret.yaml"]["data"][
+            "userdata"
+        ]
+    ).decode()
+    assert helm_payload == ours_payload
+    assert "\\u0026" in helm_payload and "\\u003c" in helm_payload
+
+
+def test_define_with_nested_if_not_truncated(chart):
+    chart._collect_defines(
+        '{{- define "t.nested" -}}A{{- if eq 1 1 }}B{{- end }}C{{ end -}}'
+    )
+    out = chart._render_text('{{ include "t.nested" . }}', {"Values": {}})
+    assert out == "ABC"
+
+
+def test_helmignore_glob_patterns(chart):
+    assert chart._is_ignored("anything.bak")
+    assert chart._is_ignored("jax-tpu-runtime.yaml.orig")
+    assert not chart._is_ignored("jax-tpu-runtime.yaml")
