@@ -1,0 +1,14 @@
+"""Test harnesses: the fake-cluster layer.
+
+The reference has no tests at all (SURVEY.md §4) — its resilience story
+(node dies -> KubeVirt reschedules the VM -> PVC re-attaches, preserving
+state, ``README.md:88-89``) was only ever demonstrated manually. kvedge-tpu
+adds the missing verification layer: a deterministic in-process simulation
+of the Kubernetes controllers the chart depends on, able to run the *real*
+container entrypoint against per-PVC backing directories so rescheduling
+tests observe genuine state survival, not a mock of it.
+"""
+
+from kvedge_tpu.testing.fakecluster import FakeCluster, FakeNode
+
+__all__ = ["FakeCluster", "FakeNode"]

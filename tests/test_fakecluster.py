@@ -1,0 +1,86 @@
+"""Fake-cluster behavior: scheduling, gating, duplicate names, endpoints."""
+
+import pytest
+
+from kvedge_tpu.config.values import DEFAULT_VALUES
+from kvedge_tpu.render import render_all
+from kvedge_tpu.testing import FakeCluster, FakeNode
+from kvedge_tpu.testing.fakecluster import FakeClusterError
+
+TPU_LABEL = {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}
+DEP = "kvedge-tpu-runtime"
+
+
+def _tpu_cluster(**kwargs):
+    return FakeCluster(
+        [
+            FakeNode("cpu-node-1"),
+            FakeNode("tpu-node-1", labels=dict(TPU_LABEL)),
+            FakeNode("tpu-node-2", labels=dict(TPU_LABEL)),
+        ],
+        **kwargs,
+    )
+
+
+def test_install_schedules_onto_tpu_node():
+    cluster = _tpu_cluster()
+    cluster.apply(render_all(DEFAULT_VALUES).manifests)
+    cluster.converge()
+    pod = cluster.running_pod(DEP)
+    assert pod is not None
+    assert pod.node in ("tpu-node-1", "tpu-node-2")
+    # The PVC bound where the pod landed.
+    assert cluster.pvcs[f"{DEP}-dv"].bound_node == pod.node
+    # The access service resolves to the runtime pod.
+    assert cluster.service_endpoints(f"{DEP}-ssh-service") == [pod.name]
+
+
+def test_no_tpu_nodes_leaves_pod_pending_with_reason():
+    cluster = FakeCluster([FakeNode("cpu-only")])
+    cluster.apply(render_all(DEFAULT_VALUES).manifests)
+    cluster.converge()
+    assert cluster.running_pod(DEP) is None
+    (pending,) = cluster.pending_pods(DEP)
+    assert "nodeSelector" in pending.reason
+
+
+def test_missing_secret_fails_like_reference_name_bug():
+    # The class of failure the reference's raw-nameOverride TODO could
+    # produce (aziot-edge-vm.yaml:57): pod referencing a Secret that was
+    # rendered under a different name.
+    cluster = _tpu_cluster()
+    manifests = dict(render_all(DEFAULT_VALUES).manifests)
+    del manifests["jax-tpu-boot-config-secret.yaml"]
+    cluster.apply(manifests)
+    with pytest.raises(FakeClusterError, match="missing Secret"):
+        cluster.converge()
+
+
+def test_duplicate_pvc_name_rejected():
+    # Why the .helmignore exclusion of the prepopulated volume is
+    # load-bearing (SURVEY.md §2 #6): rendering both volume templates
+    # collides on the resource name.
+    cluster = _tpu_cluster()
+    chart = render_all(DEFAULT_VALUES, include_dead=True)
+    with pytest.raises(FakeClusterError, match="already exists"):
+        cluster.apply(chart.manifests)
+
+
+def test_ssh_gate_removes_endpoint_surface():
+    cluster = _tpu_cluster()
+    chart = render_all(DEFAULT_VALUES.replace(tpuRuntimeEnableExternalSsh=False))
+    cluster.apply(chart.manifests)
+    cluster.converge()
+    assert f"{DEP}-ssh-service" not in cluster.services
+
+
+def test_reapply_same_manifests_is_upgrade_not_collision():
+    cluster = _tpu_cluster()
+    manifests = render_all(DEFAULT_VALUES).manifests
+    cluster.apply(manifests)
+    cluster.converge()
+    pod = cluster.running_pod(DEP)
+    cluster.apply(manifests)  # helm upgrade analogue: no duplicate error
+    cluster.converge()
+    # PVC binding survives the upgrade.
+    assert cluster.pvcs[f"{DEP}-dv"].bound_node == pod.node

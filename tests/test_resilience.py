@@ -1,0 +1,104 @@
+"""The resilience path (SURVEY.md §3.3) — the reference's raison d'être.
+
+Node dies -> controller reschedules -> PVC re-attaches -> state survives.
+Both documented storage modes are covered: the default node-bound PVC
+(recovery blocked until the node returns — the reference's README.md:89
+caveat) and resilient storage (reschedule to another node succeeds — the
+README.md:88 StorageOS mitigation). With a state_root, the tests run the
+REAL entrypoint per pod generation and assert the persisted heartbeat's
+boot_count increments — observed state survival, not a simulated flag.
+"""
+
+import json
+
+from kvedge_tpu.config.values import DEFAULT_VALUES
+from kvedge_tpu.render import render_all
+from kvedge_tpu.testing import FakeCluster, FakeNode
+
+TPU_LABEL = {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}
+DEP = "kvedge-tpu-runtime"
+
+RUNTIME_TOML = """
+[runtime]
+name = "resilience-edge"
+
+[tpu]
+platform = "cpu"
+
+[status]
+port = 18998
+bind = "127.0.0.1"
+"""
+
+
+def _cluster(tmp_path, **kwargs):
+    return FakeCluster(
+        [
+            FakeNode("tpu-node-1", labels=dict(TPU_LABEL)),
+            FakeNode("tpu-node-2", labels=dict(TPU_LABEL)),
+        ],
+        state_root=str(tmp_path / "pvc-backing"),
+        **kwargs,
+    )
+
+
+def test_node_bound_pvc_blocks_reschedule_until_node_returns(tmp_path):
+    cluster = _cluster(tmp_path)  # default: node-bound volumes
+    cluster.apply(render_all(DEFAULT_VALUES).manifests)
+    cluster.converge()
+    pod1 = cluster.running_pod(DEP)
+    first_node = pod1.node
+
+    cluster.kill_node(first_node)
+    cluster.converge()
+    # Replacement pod exists but cannot attach the node-bound volume
+    # elsewhere — the reference's documented failure mode (README.md:89).
+    assert cluster.running_pod(DEP) is None
+    (pending,) = cluster.pending_pods(DEP)
+    assert "bound to node" in pending.reason
+
+    cluster.revive_node(first_node)
+    cluster.converge()
+    pod2 = cluster.running_pod(DEP)
+    assert pod2 is not None and pod2.node == first_node
+
+
+def test_resilient_storage_reschedules_to_other_node(tmp_path):
+    cluster = _cluster(tmp_path, resilient_storage=True)
+    cluster.apply(render_all(DEFAULT_VALUES).manifests)
+    cluster.converge()
+    pod1 = cluster.running_pod(DEP)
+
+    cluster.kill_node(pod1.node)
+    cluster.converge()
+    pod2 = cluster.running_pod(DEP)
+    assert pod2 is not None
+    assert pod2.node != pod1.node
+    assert cluster.pvcs[f"{DEP}-dv"].bound_node == pod2.node
+
+
+def test_state_survives_rescheduling_with_real_entrypoint(tmp_path):
+    """The full story: reschedule + real boots + persisted boot_count."""
+    cluster = _cluster(tmp_path, resilient_storage=True)
+    values = DEFAULT_VALUES.replace(jaxRuntimeConfig=RUNTIME_TOML)
+    cluster.apply(render_all(values).manifests)
+    cluster.converge()
+
+    pod1 = cluster.running_pod(DEP)
+    rc = cluster.boot_pod(pod1, str(tmp_path / "podfs-1"))
+    assert rc == 0
+    backing = tmp_path / "pvc-backing" / f"{DEP}-dv"
+    beat1 = json.loads((backing / "heartbeat.json").read_text())
+    assert beat1["boot_count"] == 1 and beat1["ok"] is True
+
+    cluster.kill_node(pod1.node)
+    cluster.converge()
+    pod2 = cluster.running_pod(DEP)
+    assert pod2.node != pod1.node
+
+    # New pod generation, FRESH pod filesystem, same PVC backing dir.
+    rc = cluster.boot_pod(pod2, str(tmp_path / "podfs-2"))
+    assert rc == 0
+    beat2 = json.loads((backing / "heartbeat.json").read_text())
+    assert beat2["boot_count"] == 2  # state survived the reschedule
+    assert beat2["seq"] > beat1["seq"]
