@@ -1,0 +1,81 @@
+"""Benchmark: flagship transformer train-step throughput on visible devices.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` context: the reference (levi106/kvedge) publishes no
+benchmark numbers of any kind — it is a deployment accelerator with no
+compute workload (BASELINE.md; BASELINE.json records metric "N/A" and
+``published: {}``). There is therefore no reference number to normalize
+against; vs_baseline is reported as 1.0 by convention and the absolute
+throughput stands on its own.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import FLAGSHIP, _factor_mesh
+from kvedge_tpu.models import init_params, make_train_step
+from kvedge_tpu.parallel import build_mesh, shard_batch, shard_params
+
+SEQ = 512
+BATCH_PER_DEVICE = 16  # best measured throughput on v5e-1
+WARMUP_STEPS = 3
+TIMED_STEPS = 10
+
+
+def main() -> int:
+    devices = jax.devices()
+    n = len(devices)
+    mesh = build_mesh(_factor_mesh(n), devices=devices)
+
+    params = shard_params(mesh, init_params(jax.random.PRNGKey(0), FLAGSHIP))
+    init_opt, train_step = make_train_step(FLAGSHIP)
+    opt_state = init_opt(params)
+    batch = shard_batch(
+        mesh,
+        jax.random.randint(
+            jax.random.PRNGKey(1), (BATCH_PER_DEVICE * n, SEQ + 1), 0,
+            FLAGSHIP.vocab, dtype=jnp.int32,
+        ),
+    )
+
+    for _ in range(WARMUP_STEPS):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+    # float() forces a device->host transfer — a hard sync even on backends
+    # whose block_until_ready returns early (observed on the remote relay).
+    float(loss)
+
+    start = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+    final_loss = float(loss)
+    elapsed = time.perf_counter() - start
+
+    tokens = BATCH_PER_DEVICE * n * SEQ * TIMED_STEPS
+    tokens_per_sec = tokens / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "flagship_train_tokens_per_sec",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+    print(
+        f"devices={n} platform={devices[0].platform} "
+        f"loss={final_loss:.3f} elapsed={elapsed:.2f}s",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,25 @@
+"""Model payloads hosted by the runtime.
+
+The reference hosts an opaque external payload (the Azure IoT Edge daemon);
+kvedge-tpu's payload slot is JAX-native, and the flagship occupant is a
+compact decoder-only transformer LM designed TPU-first: bf16 compute onto
+the MXU, ``lax.scan`` over layers (one compiled layer body regardless of
+depth), static shapes, and Megatron-style dp×tp sharding via the rules in
+:mod:`kvedge_tpu.parallel.sharding`.
+"""
+
+from kvedge_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+]

@@ -1,0 +1,184 @@
+"""Flagship payload: a compact decoder-only transformer LM, TPU-first.
+
+Design notes (why it looks like this, not like a CUDA/torch port):
+
+* **Params are a flat pytree of stacked arrays.** All layers' weights are
+  stacked on a leading layer axis and the forward pass is one
+  ``lax.scan`` over that axis — XLA compiles ONE layer body regardless of
+  depth, and the layer axis is never sharded.
+* **bf16 compute, fp32 master params.** Matmuls (the MXU work) run in
+  bfloat16; params and optimizer state stay float32.
+* **Static shapes everywhere**; the causal mask is a compile-time constant.
+* **Sharding is annotation-only** (see parallel/sharding.py): this file
+  contains no collectives — XLA inserts them from the in_shardings.
+* **Weight tying**: logits = hidden @ embedding.T, halving embedding HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 8
+    d_ff: int = 2048
+    max_seq: int = 1024
+    dtype: str = "bfloat16"  # compute dtype
+    # Rematerialize each layer in backward instead of saving activations
+    # (notably the [T, T] attention scores, which otherwise live for every
+    # layer at once under lax.scan) — the standard HBM-for-FLOPs trade.
+    remat: bool = True
+
+    @property
+    def d_head(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    def validate(self) -> None:
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+
+
+def init_params(key, cfg: TransformerConfig) -> dict:
+    """Initialize the flat, layer-stacked param tree (fp32)."""
+    cfg.validate()
+    k_embed, k_qkv, k_out, k_up, k_down = jax.random.split(key, 5)
+    d, h, dh, f, layers = (
+        cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff, cfg.n_layers,
+    )
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale)
+
+    return {
+        "embedding": normal(k_embed, (cfg.vocab, d), 0.02),
+        "w_qkv": normal(k_qkv, (layers, d, 3 * h * dh), d ** -0.5),
+        "w_out": normal(k_out, (layers, h * dh, d), (h * dh) ** -0.5),
+        "w_up": normal(k_up, (layers, d, f), d ** -0.5),
+        "w_down": normal(k_down, (layers, f, d), f ** -0.5),
+        "ln_attn": jnp.ones((layers, d), jnp.float32),
+        "ln_mlp": jnp.ones((layers, d), jnp.float32),
+        "ln_final": jnp.ones((d,), jnp.float32),
+    }
+
+
+def _rmsnorm(x, gain):
+    scale = jax.lax.rsqrt(
+        jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        + 1e-6
+    )
+    return (x * scale.astype(x.dtype)) * gain.astype(x.dtype)
+
+
+def _rotary(x, positions):
+    """Rotary position embedding over the head dim (applied to q and k)."""
+    *_, dh = x.shape
+    half = dh // 2
+    freqs = jnp.exp(
+        -jnp.arange(0, half, dtype=jnp.float32) * (jnp.log(10000.0) / half)
+    )
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles).astype(x.dtype)
+    sin = jnp.sin(angles).astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    # broadcast [T, half] over [B, T, H, half]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+
+
+def _layer(cfg: TransformerConfig, x, layer_params):
+    """One pre-norm decoder block. x: [B, T, D] in compute dtype."""
+    w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
+    batch, seq, d = x.shape
+    h, dh = cfg.n_heads, cfg.d_head
+    dtype = x.dtype
+
+    # Attention.
+    normed = _rmsnorm(x, ln_attn)
+    qkv = normed @ w_qkv.astype(dtype)  # [B, T, 3*H*Dh]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(batch, seq, h, dh)
+    k = k.reshape(batch, seq, h, dh)
+    v = v.reshape(batch, seq, h, dh)
+    positions = jnp.arange(seq)
+    q = _rotary(q, positions)
+    k = _rotary(k, positions)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (dh ** 0.5)
+    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+    scores = jnp.where(causal[None, None], scores, jnp.finfo(dtype).min)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    attended = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    attended = attended.reshape(batch, seq, h * dh)
+    x = x + attended @ w_out.astype(dtype)
+
+    # MLP.
+    normed = _rmsnorm(x, ln_mlp)
+    up = normed @ w_up.astype(dtype)
+    x = x + jax.nn.gelu(up) @ w_down.astype(dtype)
+    return x
+
+
+def forward(params: dict, tokens, cfg: TransformerConfig):
+    """tokens [B, T] int32 -> logits [B, T, V] (fp32)."""
+    dtype = jnp.dtype(cfg.dtype)
+    embedding = params["embedding"]
+    x = embedding[tokens].astype(dtype)  # [B, T, D]
+
+    stacked = (
+        params["w_qkv"], params["w_out"], params["w_up"], params["w_down"],
+        params["ln_attn"], params["ln_mlp"],
+    )
+
+    def body(carry, layer_params):
+        return _layer(cfg, carry, layer_params), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = lax.scan(body, x, stacked)
+    x = _rmsnorm(x, params["ln_final"])
+    # Weight-tied readout in fp32 for a stable softmax.
+    return x.astype(jnp.float32) @ embedding.T
+
+
+def loss_fn(params: dict, batch, cfg: TransformerConfig):
+    """Next-token cross-entropy. batch [B, T] int32; targets are shifted."""
+    inputs = batch[:, :-1]
+    targets = batch[:, 1:]
+    logits = forward(params, inputs, cfg)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    token_ll = jnp.take_along_axis(
+        logprobs, targets[..., None], axis=-1
+    )[..., 0]
+    return -jnp.mean(token_ll)
+
+
+def make_train_step(cfg: TransformerConfig, optimizer=None):
+    """Build (init_opt_state, train_step). Donates params/opt_state buffers."""
+    import optax
+
+    if optimizer is None:
+        optimizer = optax.adamw(3e-4, weight_decay=0.01)
+
+    def init_opt_state(params):
+        return optimizer.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init_opt_state, train_step

@@ -1,0 +1,26 @@
+"""Device-mesh and sharding utilities.
+
+The reference contains no parallelism or communication layer at all
+(SURVEY.md §5: "no DP, TP, PP ... no NCCL, MPI, Gloo"); this package exists
+because the hosted *payload* is JAX-native and must scale the TPU way:
+pick a mesh, annotate shardings with ``NamedSharding``/``PartitionSpec``,
+and let XLA insert the collectives over ICI — rather than hand-writing any
+communication.
+"""
+
+from kvedge_tpu.parallel.mesh import build_mesh, local_mesh
+from kvedge_tpu.parallel.sharding import (
+    batch_spec,
+    param_specs,
+    shard_params,
+    shard_batch,
+)
+
+__all__ = [
+    "build_mesh",
+    "local_mesh",
+    "batch_spec",
+    "param_specs",
+    "shard_params",
+    "shard_batch",
+]

@@ -1,0 +1,30 @@
+"""Mesh construction from the runtime config's MeshSpec."""
+
+from __future__ import annotations
+
+from kvedge_tpu.config.runtime_config import MeshSpec
+
+
+def build_mesh(spec: MeshSpec, devices=None):
+    """Build a ``jax.sharding.Mesh`` from a (possibly inferred) MeshSpec.
+
+    ``mesh_utils.create_device_mesh`` lays devices out so that neighboring
+    mesh coordinates are ICI neighbors on TPU slices — which is why meshes
+    are built here rather than by reshaping ``jax.devices()`` by hand.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    shape = spec.resolved_shape(len(devices))
+    return Mesh(
+        mesh_utils.create_device_mesh(shape, devices=devices),
+        spec.axis_names(),
+    )
+
+
+def local_mesh(data: int = 0, model: int = 1):
+    """Convenience: a data×model mesh over all visible devices."""
+    return build_mesh(MeshSpec(axes=(("data", data), ("model", model))))

@@ -1,0 +1,59 @@
+"""Partition rules for the flagship transformer: dp × tp over a 2D mesh.
+
+The recipe (scaling-book style): annotate the *placement* of params and
+batch with ``PartitionSpec``s and let XLA's SPMD partitioner insert the
+``all-gather`` / ``reduce-scatter`` / ``psum`` collectives. Megatron-style
+tensor parallelism falls out of two rules:
+
+* column-parallel kernels (qkv, mlp-in) shard their OUTPUT feature dim on
+  the ``model`` axis;
+* row-parallel kernels (attn-out, mlp-out) shard their INPUT (contracting)
+  dim on ``model`` — XLA completes the pair with one psum per block.
+
+The batch dim shards on ``data``. Everything else (norms, biases) is
+replicated.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# Rules keyed by parameter name (the flagship model's param pytree keys).
+# Layer-stacked params carry a leading layer axis (for lax.scan), which is
+# never sharded.
+PARAM_RULES: dict[str, P] = {
+    "embedding": P(None, "model"),        # [V, D] — feature-sharded
+    "w_qkv": P(None, None, "model"),      # [L, D, 3*H*Dh] — column-parallel
+    "w_out": P(None, "model", None),      # [L, H*Dh, D] — row-parallel
+    "w_up": P(None, None, "model"),       # [L, D, F] — column-parallel
+    "w_down": P(None, "model", None),     # [L, F, D] — row-parallel
+    "ln_attn": P(),                       # [L, D] — replicated
+    "ln_mlp": P(),                        # [L, D]
+    "ln_final": P(),                      # [D]
+}
+
+
+def param_specs(params: dict) -> dict:
+    """PartitionSpec tree matching a flagship param tree."""
+    missing = set(params) - set(PARAM_RULES)
+    if missing:
+        raise ValueError(f"no partition rule for params: {sorted(missing)}")
+    return {name: PARAM_RULES[name] for name in params}
+
+
+def batch_spec() -> P:
+    """Tokens [B, T]: batch on the data axis, sequence replicated."""
+    return P("data", None)
+
+
+def shard_params(mesh, params: dict) -> dict:
+    specs = param_specs(params)
+    return {
+        name: jax.device_put(value, NamedSharding(mesh, specs[name]))
+        for name, value in params.items()
+    }
+
+
+def shard_batch(mesh, batch):
+    return jax.device_put(batch, NamedSharding(mesh, batch_spec()))

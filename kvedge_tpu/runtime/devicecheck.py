@@ -57,8 +57,9 @@ def run_device_check(cfg: RuntimeConfig) -> DeviceCheckResult:
     """Probe device visibility, then run one pjit'd matmul over the mesh."""
     import jax
     import jax.numpy as jnp
-    from jax.experimental import mesh_utils
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kvedge_tpu.parallel.mesh import build_mesh
 
     devices = jax.devices()
     platform = devices[0].platform if devices else "none"
@@ -77,13 +78,12 @@ def run_device_check(cfg: RuntimeConfig) -> DeviceCheckResult:
         )
 
     try:
-        shape = cfg.mesh.resolved_shape(count)
+        mesh = build_mesh(cfg.mesh, devices=devices)
     except Exception as e:
         return _failure(platform, count, kinds, f"mesh resolution failed: {e}")
 
     axis_names = cfg.mesh.axis_names()
-    mesh = Mesh(mesh_utils.create_device_mesh(shape, devices=devices),
-                axis_names)
+    shape = mesh.devices.shape
 
     rows = PROBE_ROWS_PER_DEVICE * count
     x_sharding = NamedSharding(mesh, P(axis_names))  # batch over all axes

@@ -1,0 +1,85 @@
+"""The transformer-probe payload: prove real sharded training works.
+
+A step up from the matmul device check: build the flagship transformer on
+the configured mesh, run one jitted, dp×tp-sharded train step, and verify
+the loss is finite and near log(vocab) for random data. This is the
+strongest "the provisioned runtime actually works" signal the status
+endpoint can report.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kvedge_tpu.config.runtime_config import RuntimeConfig
+from kvedge_tpu.runtime.devicecheck import DeviceCheckResult, run_device_check
+
+# Deliberately tiny: the probe verifies machinery, not throughput.
+PROBE_VOCAB = 512
+PROBE_D_MODEL = 128
+PROBE_LAYERS = 2
+PROBE_SEQ = 64
+PROBE_BATCH_PER_DEVICE = 2
+
+
+def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
+    # The matmul device check runs first: fail fast on visibility problems
+    # with a cheaper, clearer error before compiling a model.
+    base = run_device_check(cfg)
+    if not base.ok:
+        return base
+
+    import dataclasses
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from kvedge_tpu.models import (
+        TransformerConfig, init_params, make_train_step,
+    )
+    from kvedge_tpu.parallel import build_mesh, shard_batch, shard_params
+
+    mesh = build_mesh(cfg.mesh)
+    model_axis = dict(zip(base.mesh_axes, base.mesh_shape)).get("model", 1)
+    tcfg = TransformerConfig(
+        vocab=PROBE_VOCAB,
+        d_model=PROBE_D_MODEL,
+        n_heads=max(4, model_axis),
+        n_layers=PROBE_LAYERS,
+        d_ff=4 * PROBE_D_MODEL,
+        max_seq=PROBE_SEQ,
+    )
+    try:
+        key = jax.random.PRNGKey(0)
+        params = shard_params(mesh, init_params(key, tcfg))
+        init_opt, train_step = make_train_step(tcfg)
+        opt_state = init_opt(params)
+        batch = shard_batch(
+            mesh,
+            jax.random.randint(
+                key,
+                (PROBE_BATCH_PER_DEVICE * base.device_count, PROBE_SEQ + 1),
+                0, tcfg.vocab, dtype=jnp.int32,
+            ),
+        )
+        start = time.perf_counter()
+        params, opt_state, loss = train_step(params, opt_state, batch)
+        loss = float(loss)
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+    except Exception as e:
+        return dataclasses.replace(
+            base, ok=False, error=f"transformer probe failed: {e!r}",
+        )
+
+    # Untrained model on random tokens: loss ≈ ln(vocab). Allow a wide band;
+    # NaN/inf or wildly-off values mean broken math or sharding.
+    expected = math.log(tcfg.vocab)
+    if not math.isfinite(loss) or abs(loss - expected) > 0.5 * expected:
+        return dataclasses.replace(
+            base, ok=False,
+            error=f"probe loss {loss:.3f} far from ln(V)={expected:.3f}",
+        )
+    return dataclasses.replace(
+        base, probe_ms=elapsed_ms, probe_checksum=loss,
+    )

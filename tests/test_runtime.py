@@ -126,15 +126,44 @@ def test_payload_none_skips_devices(tmp_path):
         handle.shutdown()
 
 
-def test_unavailable_payload_degrades_not_crashes(tmp_path):
-    # A payload that raises (e.g. module missing) must leave the runtime
-    # serving a degraded /status, not crash-looping.
+def test_failing_payload_degrades_not_crashes(tmp_path, monkeypatch):
+    # A payload that raises must leave the runtime serving a degraded
+    # /status, not crash-looping.
+    from kvedge_tpu.runtime import workload
+
+    def explode(cfg):
+        raise RuntimeError("synthetic payload failure")
+
+    monkeypatch.setattr(workload, "run_transformer_probe", explode)
     handle = start_runtime(_cfg(tmp_path, payload="transformer-probe"))
     try:
-        if handle.check.ok:
-            return  # workload implemented and passing — also fine
+        assert not handle.check.ok
         assert "transformer-probe" in handle.check.error
+        assert "synthetic payload failure" in handle.check.error
         code, doc = _get(handle.status_port, "/status")
         assert code == 200 and doc["ok"] is False
     finally:
         handle.shutdown()
+
+
+def test_transformer_probe_payload(tmp_path):
+    import math
+
+    from kvedge_tpu.config.runtime_config import MeshSpec
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    cfg = _cfg(tmp_path, mesh=MeshSpec(axes=(("data", 2), ("model", 4))))
+    result = run_transformer_probe(cfg)
+    assert result.ok, result.error
+    assert result.mesh_shape == (2, 4)
+    # probe_checksum carries the train-step loss.
+    assert math.isfinite(result.probe_checksum)
+    assert result.probe_ms > 0
+
+
+def test_transformer_probe_propagates_devicecheck_failure(tmp_path):
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    result = run_transformer_probe(_cfg(tmp_path, expected_platform="tpu"))
+    assert not result.ok
+    assert "expected platform" in result.error
