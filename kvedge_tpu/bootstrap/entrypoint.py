@@ -46,21 +46,34 @@ def authorize_ssh_keys(keys: tuple[str, ...], root: str) -> str | None:
     return path
 
 
-def start_sshd_if_present(root: str) -> bool:
-    """Start sshd when the runtime image ships one; absent is not an error.
+def start_sshd_if_present(root: str, have_keys: bool) -> bool:
+    """Start sshd when the image ships one AND a public key was injected.
 
     External SSH is an optional capability gated by a chart value (the
     Service may not even exist, ``aziot-edge-vm-service.yaml:1``), so a
-    missing sshd must not fail the boot.
+    missing sshd must not fail the boot — and without an authorized key
+    there is nothing to serve, so no daemon is started at all.
+
+    The runtime image ships without SSH host keys (shared baked-in host
+    keys would let anyone who pulls the public image impersonate any
+    deployment), so they are generated here on first start.
     """
+    if root not in ("", "/"):
+        return False  # never start a real daemon from a test root
+    if not have_keys:
+        return False
     sshd = shutil.which("sshd") or (
         "/usr/sbin/sshd" if os.path.exists("/usr/sbin/sshd") else None
     )
-    if root not in ("", "/"):
-        return False  # never start a real daemon from a test root
     if not sshd:
         _log("no sshd in image; skipping SSH access setup")
         return False
+    os.makedirs("/run/sshd", exist_ok=True)  # privsep dir, absent in containers
+    if not any(
+        name.startswith("ssh_host_") for name in os.listdir("/etc/ssh")
+    ):
+        subprocess.run(["ssh-keygen", "-A"], check=False)
+        _log("generated per-container SSH host keys")
     subprocess.Popen([sshd, "-D", "-e"])
     _log(f"started {sshd}")
     return True
@@ -74,7 +87,7 @@ def run_boot_sequence(boot_config_path: str, root: str = "/") -> None:
     key_path = authorize_ssh_keys(document.ssh_authorized_keys, root)
     if key_path:
         _log(f"authorized {len(document.ssh_authorized_keys)} ssh key(s)")
-    start_sshd_if_present(root)
+    start_sshd_if_present(root, have_keys=bool(document.ssh_authorized_keys))
 
     for phase, commands in (("bootcmd", document.bootcmd),
                             ("runcmd", document.runcmd)):
