@@ -37,6 +37,10 @@ class TransformerConfig:
     # (notably the [T, T] attention scores, which otherwise live for every
     # layer at once under lax.scan) — the standard HBM-for-FLOPs trade.
     remat: bool = True
+    # "naive" materializes [T, T] scores (XLA-fused); "flash" streams K/V
+    # blocks through a Pallas kernel with an online softmax (no [T, T] in
+    # forward). Flash requires seq to be a multiple of its block size.
+    attention: str = "naive"
 
     @property
     def d_head(self) -> int:
@@ -46,6 +50,10 @@ class TransformerConfig:
     def validate(self) -> None:
         if self.d_model % self.n_heads:
             raise ValueError("d_model must be divisible by n_heads")
+        if self.attention not in ("naive", "flash"):
+            raise ValueError(
+                f"attention must be 'naive' or 'flash', got {self.attention!r}"
+            )
 
 
 def init_params(key, cfg: TransformerConfig) -> dict:
@@ -115,12 +123,32 @@ def _layer(cfg: TransformerConfig, x, layer_params):
     positions = jnp.arange(seq)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (dh ** 0.5)
-    causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
-    scores = jnp.where(causal[None, None], scores, jnp.finfo(dtype).min)
-    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
-    attended = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
-    attended = attended.reshape(batch, seq, h * dh)
+    if cfg.attention == "flash":
+        from kvedge_tpu.ops.attention import flash_attention, pick_block
+
+        # [B, T, H, dh] -> [B*H, T, dh] (head-major programs for the grid).
+        def heads_to_programs(x):
+            return x.transpose(0, 2, 1, 3).reshape(batch * h, seq, dh)
+
+        attended = flash_attention(
+            heads_to_programs(q), heads_to_programs(k), heads_to_programs(v),
+            pick_block(seq),
+            jax.default_backend() != "tpu",  # interpret kernels off-TPU
+        )
+        attended = (
+            attended.reshape(batch, h, seq, dh)
+            .transpose(0, 2, 1, 3)
+            .reshape(batch, seq, h * dh)
+        )
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (dh ** 0.5)
+        causal = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+        scores = jnp.where(causal[None, None], scores, jnp.finfo(dtype).min)
+        weights = jax.nn.softmax(
+            scores.astype(jnp.float32), axis=-1
+        ).astype(dtype)
+        attended = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        attended = attended.reshape(batch, seq, h * dh)
     x = x + attended @ w_out.astype(dtype)
 
     # MLP.

@@ -1,0 +1,11 @@
+"""TPU kernels (Pallas) for the payload's hot ops.
+
+The reference has no compute kernels of any kind (SURVEY.md §2); these exist
+to make the *payload* slot genuinely TPU-native: where XLA's automatic
+fusion isn't enough (attention's [T, T] score materialization), a Pallas
+kernel takes over.
+"""
+
+from kvedge_tpu.ops.attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -222,6 +222,30 @@ def runtime_deployment(values: ChartValues) -> dict:
                                 },
                                 "limits": {TPU_RESOURCE: TPU_CHIPS},
                             },
+                            # Probes target /version (server-alive), NOT
+                            # /healthz: a degraded runtime must stay
+                            # reachable for debugging (the analogue of
+                            # ssh-ing into a VM whose payload failed), so
+                            # kubelet must neither kill it nor pull it from
+                            # the service endpoints. /healthz (503 when
+                            # degraded) is for external monitors.
+                            "livenessProbe": {
+                                "httpGet": {
+                                    "path": "/version",
+                                    "port": "status",
+                                },
+                                # First XLA compile on a cold pod is slow.
+                                "initialDelaySeconds": 120,
+                                "periodSeconds": 10,
+                            },
+                            "readinessProbe": {
+                                "httpGet": {
+                                    "path": "/version",
+                                    "port": "status",
+                                },
+                                "initialDelaySeconds": 5,
+                                "periodSeconds": 10,
+                            },
                             "volumeMounts": [
                                 {
                                     "name": "statedisk",

@@ -171,3 +171,13 @@ def test_bad_runtime_config_fails_at_render():
 def test_ephemeral_status_port_rejected_at_render():
     with pytest.raises(ValueError, match="port 0"):
         render_all(DEFAULT_VALUES.replace(jaxRuntimeConfig="[status]\nport = 0\n"))
+
+
+def test_probes_use_version_not_healthz():
+    # Degraded runtimes must stay reachable: probes may only target the
+    # unconditional /version route, never /healthz (503 when degraded).
+    dep = render_all(DEFAULT_VALUES).manifests["jax-tpu-runtime.yaml"]
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    for probe in ("livenessProbe", "readinessProbe"):
+        assert container[probe]["httpGet"]["path"] == "/version"
+        assert container[probe]["httpGet"]["port"] == "status"
