@@ -1,0 +1,83 @@
+"""Resumable training driver: the checkpoint/resume consumer.
+
+Proves the accelerator's persistence capability end-to-end for a real JAX
+workload: training state (params, optimizer state, step) is checkpointed
+through the PVC-backed state dir, and a new pod generation resumes from the
+latest step instead of restarting — the payload-level analogue of EdgeHub's
+PVC-backed message state in the reference (``README.md:88``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable
+
+import jax
+
+from kvedge_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    make_train_step,
+)
+from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+
+
+@dataclasses.dataclass
+class TrainResult:
+    step: int
+    params: dict
+    losses: list[float]
+    resumed_from: int | None
+
+
+def run_training(
+    cfg: TransformerConfig,
+    state_dir: str,
+    num_steps: int,
+    batches: Iterable,
+    optimizer=None,
+    checkpoint_every: int = 10,
+    seed: int = 0,
+    prepare: Callable = lambda tree: tree,
+) -> TrainResult:
+    """Train for ``num_steps`` total, resuming from the latest checkpoint.
+
+    ``num_steps`` counts from step 0 across ALL runs against this state
+    dir: a rerun after a crash picks up where the checkpoint left off and
+    returns immediately if the target was already reached. ``prepare``
+    lets callers shard the (restored or fresh) state onto a mesh.
+    """
+    init_opt, train_step = make_train_step(cfg, optimizer=optimizer)
+    step = 0
+    resumed_from = None
+
+    def fresh_state():
+        params = init_params(jax.random.PRNGKey(seed), cfg)
+        return {"params": params, "opt_state": init_opt(params)}
+
+    with StateCheckpointer(state_dir) as ckpt:
+        # Abstract template first (zero allocation): materialize a fresh
+        # state only when there is nothing to restore, so a resuming pod
+        # never holds two full copies of params + optimizer state.
+        restored = ckpt.restore_latest(jax.eval_shape(fresh_state))
+        if restored is not None:
+            step, tree = restored
+            resumed_from = step
+        else:
+            tree = fresh_state()
+        params, opt_state = tree["params"], tree["opt_state"]
+        params = prepare(params)
+        opt_state = prepare(opt_state)
+
+        losses: list[float] = []
+        batch_iter = iter(batches)
+        while step < num_steps:
+            batch = next(batch_iter)
+            params, opt_state, loss = train_step(params, opt_state, batch)
+            step += 1
+            losses.append(float(loss))
+            if step % checkpoint_every == 0 or step == num_steps:
+                ckpt.save(step, {"params": params, "opt_state": opt_state})
+        return TrainResult(
+            step=step, params=params, losses=losses, resumed_from=resumed_from
+        )

@@ -1,0 +1,75 @@
+"""Checkpoint/resume through the PVC-backed state directory.
+
+The reference's whole checkpoint story is "the PVC is the checkpoint":
+EdgeHub message state survives rescheduling because the boot disk is
+PVC-backed (SURVEY.md §5, reference ``README.md:77,88``) — there is no
+application-level checkpoint code at all. kvedge-tpu keeps that property
+for the runtime's own state (heartbeats) and adds what a *JAX* payload
+actually needs: an orbax-backed layout under ``<state_dir>/checkpoints``
+so training state (params, optimizer, step) written through the PVC is
+restorable by the next pod generation (SURVEY.md §7 capability 3 calls
+for exactly this orbax-compatible layout).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+CHECKPOINT_SUBDIR = "checkpoints"
+
+
+class StateCheckpointer:
+    """Thin orbax CheckpointManager over the state volume.
+
+    Synchronous by design: the runtime's value proposition is that state
+    is on the PVC when the pod dies, so every save waits for durability.
+    """
+
+    def __init__(self, state_dir: str, keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(os.path.join(state_dir, CHECKPOINT_SUBDIR))
+        self._manager = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
+        )
+        self._ocp = ocp
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    def save(self, step: int, tree: Any) -> None:
+        self._manager.save(step, args=self._ocp.args.StandardSave(tree))
+        self._manager.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._manager.latest_step()
+
+    def restore_latest(self, abstract_tree: Any = None) -> tuple[int, Any] | None:
+        """(step, tree) of the newest checkpoint, or None on a fresh volume.
+
+        ``abstract_tree`` (e.g. ``jax.eval_shape`` output or a concrete
+        template) restores with the correct dtypes/shardings; omitting it
+        falls back to orbax's topology inference.
+        """
+        step = self._manager.latest_step()
+        if step is None:
+            return None
+        if abstract_tree is not None:
+            tree = self._manager.restore(
+                step, args=self._ocp.args.StandardRestore(abstract_tree)
+            )
+        else:
+            tree = self._manager.restore(step)
+        return step, tree
+
+    def close(self) -> None:
+        self._manager.close()
+
+    def __enter__(self) -> "StateCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,9 +1,11 @@
 """HTTP status endpoint — the runtime's externally reachable smoke surface.
 
 The reference's post-install verification is human: ``kubectl get vmi``
-shows Running, then ssh in (``NOTES.txt:8-12``). kvedge-tpu adds a machine
-surface behind the same LoadBalancer: ``/healthz`` for probes, ``/status``
-for the full runtime picture (devices, mesh, heartbeat age, boot count).
+shows Running, then ssh in (``NOTES.txt:8-12``); it has no observability
+subsystem at all (SURVEY.md §5). kvedge-tpu adds a machine surface behind
+the same LoadBalancer: ``/healthz`` for external monitors, ``/status`` for
+the full runtime picture (devices, mesh, heartbeat age, boot count),
+``/metrics`` in Prometheus text format, ``/version`` for kubelet probes.
 """
 
 from __future__ import annotations
@@ -14,6 +16,40 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from kvedge_tpu.version import __version__
+
+_METRIC_FIELDS = (
+    # (snapshot key, metric suffix, help text)
+    ("ok", "up", "1 if the runtime payload check passed"),
+    ("boot_count", "boot_count", "boots observed on this state volume"),
+    ("uptime_s", "uptime_seconds", "seconds since this runtime booted"),
+    ("heartbeat_seq", "heartbeat_seq", "monotonic heartbeat sequence"),
+    ("heartbeat_age_s", "heartbeat_age_seconds", "age of the last heartbeat"),
+)
+
+
+def render_metrics(snapshot: dict) -> str:
+    """Render a /status snapshot as Prometheus text exposition format."""
+    lines = []
+    for key, suffix, help_text in _METRIC_FIELDS:
+        value = snapshot.get(key)
+        if isinstance(value, bool):
+            value = int(value)
+        if value is None:
+            continue
+        name = f"kvedge_{suffix}"
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+    check = snapshot.get("check", {})
+    if check.get("probe_ms") is not None:
+        lines.append("# HELP kvedge_probe_ms payload probe duration")
+        lines.append("# TYPE kvedge_probe_ms gauge")
+        lines.append(f"kvedge_probe_ms {check['probe_ms']}")
+    if check.get("device_count") is not None:
+        lines.append("# HELP kvedge_devices visible accelerator devices")
+        lines.append("# TYPE kvedge_devices gauge")
+        lines.append(f"kvedge_devices {check['device_count']}")
+    return "\n".join(lines) + "\n"
 
 
 class StatusServer:
@@ -37,14 +73,23 @@ class StatusServer:
 
             def _send(self, code: int, doc: dict) -> None:
                 body = json.dumps(doc, indent=2, sort_keys=True).encode()
+                self._send_raw(code, body, "application/json")
+
+            def _send_raw(self, code: int, body: bytes, ctype: str) -> None:
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                if self.path == "/metrics":
+                    self._send_raw(
+                        200,
+                        render_metrics(outer._snapshot()).encode(),
+                        "text/plain; version=0.0.4",
+                    )
+                elif self.path == "/healthz":
                     healthy = outer._healthy()
                     self._send(200 if healthy else 503,
                                {"status": "ok" if healthy else "degraded"})

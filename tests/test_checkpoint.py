@@ -1,0 +1,89 @@
+"""Checkpoint/resume through the state volume (orbax layout)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kvedge_tpu.models import TransformerConfig
+from kvedge_tpu.models.training import run_training
+from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+
+TINY = TransformerConfig(
+    vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=16
+)
+
+
+def _batches(key=7):
+    batch = jax.random.randint(
+        jax.random.PRNGKey(key), (4, 17), 0, TINY.vocab, dtype=jnp.int32
+    )
+    while True:
+        yield batch
+
+
+def test_save_restore_roundtrip(tmp_path):
+    tree = {"w": jnp.arange(8.0), "nested": {"b": jnp.ones((2, 2))}}
+    with StateCheckpointer(str(tmp_path)) as ckpt:
+        assert ckpt.restore_latest() is None  # fresh volume
+        ckpt.save(3, tree)
+        step, restored = ckpt.restore_latest(
+            jax.eval_shape(lambda t: t, tree)
+        )
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(tree["w"]))
+
+
+def test_max_to_keep_prunes(tmp_path):
+    with StateCheckpointer(str(tmp_path), keep=2) as ckpt:
+        for step in (1, 2, 3):
+            ckpt.save(step, {"w": jnp.full((2,), float(step))})
+        assert ckpt.latest_step() == 3
+
+
+def test_training_resumes_across_crash(tmp_path):
+    """Two runs over the same state dir behave like one 10-step run."""
+    state = str(tmp_path / "state")
+    opt = optax.adam(1e-2)
+
+    first = run_training(
+        TINY, state, num_steps=5, batches=_batches(), optimizer=opt,
+        checkpoint_every=5,
+    )
+    assert first.resumed_from is None and first.step == 5
+
+    # "Pod rescheduled": fresh process state, same volume.
+    second = run_training(
+        TINY, state, num_steps=10, batches=_batches(), optimizer=opt,
+        checkpoint_every=5,
+    )
+    assert second.resumed_from == 5
+    assert second.step == 10
+    assert len(second.losses) == 5  # only the remaining steps ran
+
+    # Resume continued training rather than restarting: the loss picked up
+    # below the first run's start.
+    assert second.losses[0] < first.losses[0]
+
+    # Already at target: returns without training.
+    third = run_training(
+        TINY, state, num_steps=10, batches=_batches(), optimizer=opt,
+    )
+    assert third.step == 10 and third.losses == []
+
+
+def test_training_unused_batches_not_consumed(tmp_path):
+    """At an already-reached target no batch is drawn from the iterator."""
+    state = str(tmp_path / "state")
+    run_training(TINY, state, num_steps=2, batches=_batches(),
+                 optimizer=optax.adam(1e-2), checkpoint_every=2)
+
+    def exploding():
+        raise AssertionError("batch drawn despite target reached")
+        yield
+
+    result = run_training(TINY, state, num_steps=2, batches=exploding(),
+                          optimizer=optax.adam(1e-2))
+    assert result.step == 2 and result.losses == []

@@ -167,3 +167,36 @@ def test_transformer_probe_propagates_devicecheck_failure(tmp_path):
     result = run_transformer_probe(_cfg(tmp_path, expected_platform="tpu"))
     assert not result.ok
     assert "expected platform" in result.error
+
+
+def test_metrics_endpoint(tmp_path):
+    import urllib.request
+
+    handle = start_runtime(_cfg(tmp_path))
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{handle.status_port}/metrics"
+        ) as resp:
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert "kvedge_up 1" in body
+        assert "kvedge_boot_count 1" in body
+        assert "kvedge_devices 8" in body
+        assert "# TYPE kvedge_up gauge" in body
+    finally:
+        handle.shutdown()
+
+
+def test_metrics_report_zero_probe_ms_for_skipped_payload(tmp_path):
+    from kvedge_tpu.runtime.status import render_metrics
+
+    handle = start_runtime(_cfg(tmp_path, payload="none"))
+    try:
+        body = render_metrics(handle.snapshot())
+        # Sentinel zeros must be emitted, not dropped (dashboards keyed on
+        # the series should see 0, not a vanished metric).
+        assert "kvedge_probe_ms 0.0" in body
+        assert "kvedge_devices 0" in body
+    finally:
+        handle.shutdown()
