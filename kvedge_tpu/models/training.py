@@ -39,15 +39,18 @@ def run_training(
     checkpoint_every: int = 10,
     seed: int = 0,
     prepare: Callable = lambda tree: tree,
+    mesh=None,
 ) -> TrainResult:
     """Train for ``num_steps`` total, resuming from the latest checkpoint.
 
     ``num_steps`` counts from step 0 across ALL runs against this state
     dir: a rerun after a crash picks up where the checkpoint left off and
     returns immediately if the target was already reached. ``prepare``
-    lets callers shard the (restored or fresh) state onto a mesh.
+    lets callers shard the (restored or fresh) state onto a mesh;
+    ``mesh`` is required when ``cfg.attention == 'ring'`` (see
+    :func:`make_train_step`).
     """
-    init_opt, train_step = make_train_step(cfg, optimizer=optimizer)
+    init_opt, train_step = make_train_step(cfg, optimizer=optimizer, mesh=mesh)
     step = 0
     resumed_from = None
 

@@ -39,7 +39,10 @@ class TransformerConfig:
     remat: bool = True
     # "naive" materializes [T, T] scores (XLA-fused); "flash" streams K/V
     # blocks through a Pallas kernel with an online softmax (no [T, T] in
-    # forward). Flash requires seq to be a multiple of its block size.
+    # forward); "ring" shards the sequence over the mesh's ``seq`` axis
+    # with ppermute rotation (parallel/ringattention.py) — long-context
+    # mode, requires passing a mesh with a ``seq`` axis to forward().
+    # Flash requires seq to be a multiple of its block size.
     attention: str = "naive"
 
     @property
@@ -50,9 +53,10 @@ class TransformerConfig:
     def validate(self) -> None:
         if self.d_model % self.n_heads:
             raise ValueError("d_model must be divisible by n_heads")
-        if self.attention not in ("naive", "flash"):
+        if self.attention not in ("naive", "flash", "ring"):
             raise ValueError(
-                f"attention must be 'naive' or 'flash', got {self.attention!r}"
+                "attention must be 'naive', 'flash', or 'ring', "
+                f"got {self.attention!r}"
             )
 
 
@@ -106,7 +110,7 @@ def _rotary(x, positions):
     )
 
 
-def _layer(cfg: TransformerConfig, x, layer_params):
+def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
     """One pre-norm decoder block. x: [B, T, D] in compute dtype."""
     w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
     batch, seq, d = x.shape
@@ -123,7 +127,17 @@ def _layer(cfg: TransformerConfig, x, layer_params):
     positions = jnp.arange(seq)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
-    if cfg.attention == "flash":
+    if cfg.attention == "ring":
+        from kvedge_tpu.parallel.ringattention import ring_attention
+
+        if mesh is None:
+            raise ValueError(
+                "attention='ring' needs a mesh with a 'seq' axis passed to "
+                "forward()/make_train_step()"
+            )
+        attended = ring_attention(q, k, v, mesh)
+        attended = attended.reshape(batch, seq, h * dh)
+    elif cfg.attention == "flash":
         from kvedge_tpu.ops.attention import flash_attention, pick_block
 
         # [B, T, H, dh] -> [B*H, T, dh] (head-major programs for the grid).
@@ -158,11 +172,27 @@ def _layer(cfg: TransformerConfig, x, layer_params):
     return x
 
 
-def forward(params: dict, tokens, cfg: TransformerConfig):
-    """tokens [B, T] int32 -> logits [B, T, V] (fp32)."""
+def forward(params: dict, tokens, cfg: TransformerConfig, mesh=None):
+    """tokens [B, T] int32 -> logits [B, T, V] (fp32).
+
+    ``mesh`` is only needed for ``attention='ring'`` (sequence
+    parallelism); when given, activations are pinned seq-sharded between
+    layers so the LN/MLP work stays sequence-parallel too.
+    """
     dtype = jnp.dtype(cfg.dtype)
     embedding = params["embedding"]
     x = embedding[tokens].astype(dtype)  # [B, T, D]
+
+    constrain = None
+    if cfg.attention == "ring" and mesh is not None:
+        from kvedge_tpu.parallel.ringattention import sequence_sharding
+
+        sharding = sequence_sharding(mesh)
+
+        def constrain(x):
+            return lax.with_sharding_constraint(x, sharding)
+
+        x = constrain(x)
 
     stacked = (
         params["w_qkv"], params["w_out"], params["w_up"], params["w_down"],
@@ -170,7 +200,10 @@ def forward(params: dict, tokens, cfg: TransformerConfig):
     )
 
     def body(carry, layer_params):
-        return _layer(cfg, carry, layer_params), None
+        out = _layer(cfg, carry, layer_params, mesh)
+        if constrain is not None:
+            out = constrain(out)
+        return out, None
 
     if cfg.remat:
         body = jax.checkpoint(body)
@@ -180,11 +213,11 @@ def forward(params: dict, tokens, cfg: TransformerConfig):
     return x.astype(jnp.float32) @ embedding.T
 
 
-def loss_fn(params: dict, batch, cfg: TransformerConfig):
+def loss_fn(params: dict, batch, cfg: TransformerConfig, mesh=None):
     """Next-token cross-entropy. batch [B, T] int32; targets are shifted."""
     inputs = batch[:, :-1]
     targets = batch[:, 1:]
-    logits = forward(params, inputs, cfg)
+    logits = forward(params, inputs, cfg, mesh)
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     token_ll = jnp.take_along_axis(
         logprobs, targets[..., None], axis=-1
@@ -192,8 +225,13 @@ def loss_fn(params: dict, batch, cfg: TransformerConfig):
     return -jnp.mean(token_ll)
 
 
-def make_train_step(cfg: TransformerConfig, optimizer=None):
-    """Build (init_opt_state, train_step). Donates params/opt_state buffers."""
+def make_train_step(cfg: TransformerConfig, optimizer=None, mesh=None):
+    """Build (init_opt_state, train_step). Donates params/opt_state buffers.
+
+    ``mesh`` is required when ``cfg.attention == 'ring'`` (the ring's
+    shard_map needs the concrete mesh); otherwise sharding stays
+    annotation-only and the mesh argument is unused.
+    """
     import optax
 
     if optimizer is None:
@@ -204,7 +242,7 @@ def make_train_step(cfg: TransformerConfig, optimizer=None):
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

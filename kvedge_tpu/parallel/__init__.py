@@ -9,6 +9,7 @@ communication.
 """
 
 from kvedge_tpu.parallel.mesh import build_mesh, local_mesh
+from kvedge_tpu.parallel.ringattention import ring_attention, sequence_sharding
 from kvedge_tpu.parallel.sharding import (
     batch_spec,
     param_specs,
@@ -21,6 +22,8 @@ __all__ = [
     "local_mesh",
     "batch_spec",
     "param_specs",
+    "ring_attention",
+    "sequence_sharding",
     "shard_params",
     "shard_batch",
 ]

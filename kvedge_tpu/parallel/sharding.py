@@ -34,21 +34,39 @@ PARAM_RULES: dict[str, P] = {
 }
 
 
-def param_specs(params: dict) -> dict:
-    """PartitionSpec tree matching a flagship param tree."""
+def _prune(spec: P, mesh) -> P:
+    """Drop axis names the mesh doesn't have (e.g. no ``model`` on a
+    data×seq ring mesh) — the dims fall back to replicated."""
+    if mesh is None:
+        return spec
+    names = set(mesh.axis_names)
+    return P(*(axis if axis in names else None for axis in spec))
+
+
+def param_specs(params: dict, mesh=None) -> dict:
+    """PartitionSpec tree matching a flagship param tree.
+
+    With ``mesh``, rules referencing axes the mesh lacks degrade to
+    replicated on those dims.
+    """
     missing = set(params) - set(PARAM_RULES)
     if missing:
         raise ValueError(f"no partition rule for params: {sorted(missing)}")
-    return {name: PARAM_RULES[name] for name in params}
+    return {name: _prune(PARAM_RULES[name], mesh) for name in params}
 
 
-def batch_spec() -> P:
-    """Tokens [B, T]: batch on the data axis, sequence replicated."""
-    return P("data", None)
+def batch_spec(mesh=None) -> P:
+    """Tokens [B, T]: batch on the data axis, sequence replicated.
+
+    (Under ring attention the *activations* are seq-sharded between
+    layers; the [B, T+1] token batch itself stays seq-replicated — T+1
+    doesn't divide the seq axis, and resharding one int32 array is noise.)
+    """
+    return _prune(P("data", None), mesh)
 
 
 def shard_params(mesh, params: dict) -> dict:
-    specs = param_specs(params)
+    specs = param_specs(params, mesh)
     return {
         name: jax.device_put(value, NamedSharding(mesh, specs[name]))
         for name, value in params.items()
@@ -56,4 +74,4 @@ def shard_params(mesh, params: dict) -> dict:
 
 
 def shard_batch(mesh, batch):
-    return jax.device_put(batch, NamedSharding(mesh, batch_spec()))
+    return jax.device_put(batch, NamedSharding(mesh, batch_spec(mesh)))

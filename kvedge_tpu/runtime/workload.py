@@ -41,7 +41,12 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
     from kvedge_tpu.parallel import build_mesh, shard_batch, shard_params
 
     mesh = build_mesh(cfg.mesh)
-    model_axis = dict(zip(base.mesh_axes, base.mesh_shape)).get("model", 1)
+    axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
+    model_axis = axis_sizes.get("model", 1)
+    # A `seq` axis in the operator's mesh selects the long-context path:
+    # the probe then exercises ring attention's ppermute ring, not just
+    # the annotation-sharded dp×tp step.
+    ring = axis_sizes.get("seq", 1) > 1
     tcfg = TransformerConfig(
         vocab=PROBE_VOCAB,
         d_model=PROBE_D_MODEL,
@@ -49,11 +54,12 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
         n_layers=PROBE_LAYERS,
         d_ff=4 * PROBE_D_MODEL,
         max_seq=PROBE_SEQ,
+        attention="ring" if ring else "naive",
     )
     try:
         key = jax.random.PRNGKey(0)
         params = shard_params(mesh, init_params(key, tcfg))
-        init_opt, train_step = make_train_step(tcfg)
+        init_opt, train_step = make_train_step(tcfg, mesh=mesh if ring else None)
         opt_state = init_opt(params)
         batch = shard_batch(
             mesh,

@@ -200,3 +200,18 @@ def test_metrics_report_zero_probe_ms_for_skipped_payload(tmp_path):
         assert "kvedge_devices 0" in body
     finally:
         handle.shutdown()
+
+
+def test_transformer_probe_ring_on_seq_mesh(tmp_path):
+    """A `seq` axis in the operator's mesh routes the probe through ring
+    attention (the long-context path) — and it still converges to ~ln(V)."""
+    import math
+
+    from kvedge_tpu.config.runtime_config import MeshSpec
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    cfg = _cfg(tmp_path, mesh=MeshSpec(axes=(("data", 2), ("seq", 4))))
+    result = run_transformer_probe(cfg)
+    assert result.ok, result.error
+    assert result.mesh_shape == (2, 4)
+    assert math.isfinite(result.probe_checksum)
