@@ -92,6 +92,38 @@ class MeshSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class DistributedSpec:
+    """Multi-host topology the runtime should join at boot.
+
+    ``num_processes == 1`` (the default) means single-host: no
+    coordination service, no ``jax.distributed`` — identical to the
+    pre-multi-host behavior. With N > 1 hosts, each pod resolves its own
+    process id and the coordinator address at boot
+    (:mod:`kvedge_tpu.parallel.distributed`); ``-1`` / ``""`` mean
+    "infer from pod identity" (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES env
+    on GKE multi-host slices, or a ``<name>-<ordinal>`` hostname).
+    """
+
+    num_processes: int = 1
+    coordinator_address: str = ""  # "" = infer; "host" or "host:port"
+    coordinator_port: int = 8478
+    process_id: int = -1  # -1 = infer
+
+    def validate(self) -> None:
+        if self.num_processes < 1:
+            raise RuntimeConfigError(
+                "[distributed] num_processes must be >= 1"
+            )
+        if not (0 < self.coordinator_port < 65536):
+            raise RuntimeConfigError("[distributed] coordinator_port out of range")
+        if self.process_id < -1 or self.process_id >= self.num_processes:
+            raise RuntimeConfigError(
+                f"[distributed] process_id {self.process_id} not in "
+                f"[-1, {self.num_processes})"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class RuntimeConfig:
     """Validated runtime config (the parsed form of the opaque TOML)."""
 
@@ -101,6 +133,7 @@ class RuntimeConfig:
     expected_platform: str = "tpu"
     expected_chips: int = 0  # 0 = accept whatever is visible
     mesh: MeshSpec = MeshSpec()
+    distributed: DistributedSpec = DistributedSpec()
     status_port: int = 8476
     status_bind: str = "0.0.0.0"
     payload: str = "devicecheck"
@@ -119,6 +152,7 @@ class RuntimeConfig:
         runtime = dict(doc.get("runtime", {}))
         tpu = dict(doc.get("tpu", {}))
         mesh_doc = dict(doc.get("mesh", {}))
+        dist_doc = dict(doc.get("distributed", {}))
         status = dict(doc.get("status", {}))
         payload_doc = dict(doc.get("payload", {}))
 
@@ -137,6 +171,23 @@ class RuntimeConfig:
                 expected_platform=str(tpu.get("platform", cls.expected_platform)),
                 expected_chips=int(tpu.get("expected_chips", cls.expected_chips)),
                 mesh=MeshSpec(axes=tuple(axes)),
+                distributed=DistributedSpec(
+                    num_processes=int(
+                        dist_doc.get("num_processes",
+                                     DistributedSpec.num_processes)
+                    ),
+                    coordinator_address=str(
+                        dist_doc.get("coordinator_address",
+                                     DistributedSpec.coordinator_address)
+                    ),
+                    coordinator_port=int(
+                        dist_doc.get("coordinator_port",
+                                     DistributedSpec.coordinator_port)
+                    ),
+                    process_id=int(
+                        dist_doc.get("process_id", DistributedSpec.process_id)
+                    ),
+                ),
                 status_port=int(status.get("port", cls.status_port)),
                 status_bind=str(status.get("bind", cls.status_bind)),
                 payload=str(payload_doc.get("kind", cls.payload)),
@@ -164,6 +215,7 @@ class RuntimeConfig:
                 f"got {self.payload!r}"
             )
         self.mesh.validate()
+        self.distributed.validate()
 
     def to_toml(self) -> str:
         """Serialize back to TOML (the form written by ``config apply``).
@@ -184,6 +236,11 @@ class RuntimeConfig:
             f"expected_chips = {self.expected_chips}\n"
             "\n[mesh]\n"
             f"axes = {{ {axes} }}\n"
+            "\n[distributed]\n"
+            f"num_processes = {self.distributed.num_processes}\n"
+            f"coordinator_address = {s(self.distributed.coordinator_address)}\n"
+            f"coordinator_port = {self.distributed.coordinator_port}\n"
+            f"process_id = {self.distributed.process_id}\n"
             "\n[status]\n"
             f"port = {self.status_port}\n"
             f"bind = {s(self.status_bind)}\n"

@@ -1,0 +1,147 @@
+"""Multi-host bootstrap: join a cross-host JAX cluster at runtime boot.
+
+The reference is explicitly single-VM — its only "communication" is K8s
+networking (SURVEY.md §5) — but a TPU runtime provisioned on a GKE
+*multi-host* slice (e.g. v5e-16 spanning 4 hosts) must form one JAX
+process group before any payload runs, or each pod would only see its own
+4 chips. The TPU-native mechanism is ``jax.distributed.initialize``:
+after it, ``jax.devices()`` is the whole slice and XLA collectives ride
+ICI/DCN transparently — the same mesh/NamedSharding code runs unchanged
+(this replaces nothing like NCCL/MPI in the reference; there is nothing
+to replace).
+
+Identity resolution mirrors the boot-config philosophy (behavior is data,
+discovered at boot, not baked into images):
+
+* process id: explicit config > ``KVEDGE_PROCESS_ID`` env >
+  ``TPU_WORKER_ID`` env (set by GKE on multi-host TPU node pools) >
+  trailing ``-<ordinal>`` of the pod hostname (StatefulSet convention).
+* coordinator: explicit config > ``KVEDGE_COORDINATOR`` env > first host
+  of ``TPU_WORKER_HOSTNAMES`` env (comma-separated, also set by GKE).
+
+``num_processes == 1`` is a strict no-op: single-host installs never pay
+for (or depend on) a coordination service.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import socket
+from typing import Mapping
+
+from kvedge_tpu.config.runtime_config import DistributedSpec, RuntimeConfigError
+
+_HOST_ORDINAL = re.compile(r"-(\d+)$")
+
+# Set once jax.distributed.initialize succeeds in this process; initialize
+# is process-global and cannot run twice.
+_initialized_as: "DistributedState | None" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedState:
+    """What the runtime joined (or why it didn't need to)."""
+
+    active: bool
+    num_processes: int = 1
+    process_id: int = 0
+    coordinator: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def resolve_process_id(spec: DistributedSpec,
+                       environ: Mapping[str, str],
+                       hostname: str) -> int:
+    """This pod's process index, from config > env > hostname ordinal."""
+    if spec.process_id >= 0:
+        return spec.process_id
+    for var in ("KVEDGE_PROCESS_ID", "TPU_WORKER_ID"):
+        if var in environ:
+            try:
+                pid = int(environ[var])
+            except ValueError:
+                raise RuntimeConfigError(
+                    f"env {var}={environ[var]!r} is not an integer"
+                ) from None
+            break
+    else:
+        m = _HOST_ORDINAL.search(hostname)
+        if not m:
+            raise RuntimeConfigError(
+                "cannot infer process_id: set [distributed] process_id, "
+                "KVEDGE_PROCESS_ID / TPU_WORKER_ID env, or run with an "
+                f"ordinal hostname (got {hostname!r})"
+            )
+        pid = int(m.group(1))
+    if not (0 <= pid < spec.num_processes):
+        raise RuntimeConfigError(
+            f"resolved process_id {pid} out of range for "
+            f"num_processes={spec.num_processes}"
+        )
+    return pid
+
+
+def resolve_coordinator(spec: DistributedSpec,
+                        environ: Mapping[str, str]) -> str:
+    """The process-0 coordination endpoint, as ``host:port``."""
+    addr = spec.coordinator_address or environ.get("KVEDGE_COORDINATOR", "")
+    if not addr:
+        hostnames = environ.get("TPU_WORKER_HOSTNAMES", "")
+        addr = hostnames.split(",")[0].strip() if hostnames else ""
+    if not addr:
+        raise RuntimeConfigError(
+            "cannot infer coordinator: set [distributed] "
+            "coordinator_address, KVEDGE_COORDINATOR, or "
+            "TPU_WORKER_HOSTNAMES env"
+        )
+    if ":" not in addr:
+        addr = f"{addr}:{spec.coordinator_port}"
+    return addr
+
+
+def maybe_initialize(spec: DistributedSpec,
+                     environ: Mapping[str, str] | None = None,
+                     hostname: str | None = None) -> DistributedState:
+    """Join the multi-host cluster if the config declares one.
+
+    Returns the resulting state; raises ``RuntimeConfigError`` on
+    unresolvable identity and propagates ``jax.distributed`` connection
+    failures (the caller degrades the runtime rather than crash-looping).
+    Idempotent within a process as long as the spec doesn't change.
+    """
+    global _initialized_as
+    spec.validate()
+    if spec.num_processes <= 1:
+        return DistributedState(active=False)
+    environ = os.environ if environ is None else environ
+    hostname = socket.gethostname() if hostname is None else hostname
+
+    process_id = resolve_process_id(spec, environ, hostname)
+    coordinator = resolve_coordinator(spec, environ)
+    state = DistributedState(
+        active=True,
+        num_processes=spec.num_processes,
+        process_id=process_id,
+        coordinator=coordinator,
+    )
+    if _initialized_as is not None:
+        if _initialized_as != state:
+            raise RuntimeConfigError(
+                f"jax.distributed already initialized as {_initialized_as}, "
+                f"cannot re-initialize as {state}"
+            )
+        return state
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=spec.num_processes,
+        process_id=process_id,
+    )
+    _initialized_as = state
+    return state

@@ -13,6 +13,7 @@ import dataclasses
 import time
 
 from kvedge_tpu.config.runtime_config import RuntimeConfig
+from kvedge_tpu.parallel.distributed import DistributedState, maybe_initialize
 from kvedge_tpu.runtime import heartbeat
 from kvedge_tpu.runtime.devicecheck import DeviceCheckResult, run_device_check
 from kvedge_tpu.runtime.status import StatusServer
@@ -28,6 +29,9 @@ class RuntimeHandle:
     server: StatusServer
     boot_count: int
     started_at: float
+    distributed: DistributedState = dataclasses.field(
+        default_factory=lambda: DistributedState(active=False)
+    )
 
     @property
     def status_port(self) -> int:
@@ -46,11 +50,23 @@ class RuntimeHandle:
             "heartbeat_age_s": (
                 round(time.time() - last["ts"], 3) if "ts" in last else None
             ),
+            "distributed": self.distributed.to_dict(),
         }
 
     def shutdown(self) -> None:
         self.writer.stop()
         self.server.shutdown()
+
+
+def _degraded(error: str) -> DeviceCheckResult:
+    """A failed check that still serves /status (degraded, debuggable from
+    outside — like ssh-ing into a VM whose payload daemon failed) instead
+    of crash-looping the pod with a raw traceback."""
+    return DeviceCheckResult(
+        ok=False, platform="unknown", device_count=0, device_kinds=(),
+        mesh_axes=(), mesh_shape=(), probe_ms=0.0, probe_checksum=0.0,
+        error=error,
+    )
 
 
 def _run_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
@@ -66,21 +82,27 @@ def _run_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             return run_transformer_probe(cfg)
         return run_device_check(cfg)
     except Exception as e:
-        # A payload failure must leave the pod serving /status (degraded,
-        # debuggable from outside — like ssh-ing into a VM whose payload
-        # daemon failed), never crash-looping with a raw traceback.
-        return DeviceCheckResult(
-            ok=False, platform="unknown", device_count=0, device_kinds=(),
-            mesh_axes=(), mesh_shape=(), probe_ms=0.0, probe_checksum=0.0,
-            error=f"payload {cfg.payload!r} failed: {e!r}",
-        )
+        return _degraded(f"payload {cfg.payload!r} failed: {e!r}")
 
 
 def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
     """Run the payload once, then start the heartbeat loop + status server."""
     started_at = time.time()
     boot_count = heartbeat.next_boot_count(cfg.state_dir)
-    check = _run_payload(cfg)
+
+    # Multi-host: join the cross-host JAX cluster BEFORE the payload, so
+    # jax.devices() sees the whole slice. A join failure degrades the pod
+    # (status stays queryable) instead of crash-looping it.
+    dist = DistributedState(active=False)
+    try:
+        dist = maybe_initialize(cfg.distributed)
+    except Exception as e:
+        check = _degraded(
+            f"multi-host join failed "
+            f"(num_processes={cfg.distributed.num_processes}): {e!r}"
+        )
+    else:
+        check = _run_payload(cfg)
 
     handle: RuntimeHandle = None  # assigned below; closures capture it
 
@@ -103,7 +125,7 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
     )
     handle = RuntimeHandle(
         cfg=cfg, check=check, writer=writer, server=server,
-        boot_count=boot_count, started_at=started_at,
+        boot_count=boot_count, started_at=started_at, distributed=dist,
     )
     writer.beat_once()  # heartbeat visible before the server answers
     server.start()
