@@ -104,7 +104,8 @@ def test_model_grad_parity_flash_vs_naive():
 def test_pick_block():
     from kvedge_tpu.ops.attention import pick_block
 
-    assert pick_block(512) == 128
+    assert pick_block(512) == 512
+    assert pick_block(1024) == 512  # grid-overhead sweet spot, not 1024
     assert pick_block(96) == 32
     assert pick_block(40) == 8
     with pytest.raises(ValueError, match="divisible by 8"):
@@ -114,3 +115,26 @@ def test_pick_block():
 def test_attention_kind_validated():
     with pytest.raises(ValueError, match="attention"):
         TransformerConfig(attention="Flash").validate()
+
+
+def test_default_block_accepts_any_multiple_of_eight():
+    # block=None must fall back to pick_block: seq=40 divides no
+    # power-of-two block above 8, and was rejected outright when the
+    # default was a hardcoded DEFAULT_BLOCK.
+    q, k, v = _qkv(jax.random.PRNGKey(7))
+    q, k, v = q[:, :40], k[:, :40], v[:, :40]
+    out = flash_attention(q, k, v, None, True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_naive(q, k, v)), rtol=2e-5, atol=2e-5
+    )
+    grads = jax.grad(
+        lambda *a: jnp.sum(jnp.square(flash_attention(*a, None, True))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    ref = jax.grad(
+        lambda *a: jnp.sum(jnp.square(_naive(*a))), argnums=(0, 1, 2)
+    )(q, k, v)
+    for gf, gn in zip(grads, ref):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gn), rtol=2e-4, atol=2e-4
+        )
