@@ -49,6 +49,10 @@ def locate(serial: str, search_root: str, link: str) -> str:
     tmp = f"{link}.tmp"
     if os.path.islink(tmp) or os.path.exists(tmp):
         os.remove(tmp)
-    os.symlink(candidate, tmp)
+    # The target must be absolute: a relative symlink target resolves
+    # against the LINK's directory, not the invoker's cwd, so a relative
+    # search root (e.g. `entrypoint --root .`) would produce a dangling
+    # link like mnt/app-secret -> mnt/disks/<serial>.
+    os.symlink(os.path.abspath(candidate), tmp)
     os.replace(tmp, link)
-    return candidate
+    return os.path.abspath(candidate)

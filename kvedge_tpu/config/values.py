@@ -1,7 +1,9 @@
-"""The chart's entire user-facing config surface: six values.
+"""The chart's entire user-facing config surface: six mirrored values + one.
 
-This mirrors the reference's ``deployment/helm/values.yaml`` value-for-value
-(SURVEY.md §2 #2). The mapping, with the reference value each one replaces:
+Six values mirror the reference's ``deployment/helm/values.yaml``
+value-for-value (SURVEY.md §2 #2); ``tpuNumHosts`` is the one documented
+addition (multi-host slices — see its field comment). The mapping, with the
+reference value each one replaces:
 
 ====================================  =========================================
 reference (values.yaml)               kvedge-tpu
@@ -39,7 +41,8 @@ _ACCELERATOR_RE = re.compile(r"^[a-z0-9]([a-z0-9-]*[a-z0-9])?$")
 
 @dataclasses.dataclass(frozen=True)
 class ChartValues:
-    """The six chart values (see module docstring for the reference mapping)."""
+    """The chart values: six reference mirrors + ``tpuNumHosts`` (see
+    module docstring for the reference mapping)."""
 
     # State PVC size (reference: aziotEdgeVmDiskSize, values.yaml:2).
     tpuRuntimeDiskSize: str = "4Gi"
@@ -58,6 +61,14 @@ class ChartValues:
     # Stable hardware identity: GKE TPU accelerator type for the node selector
     # (reference: macAddress, values.yaml:17).
     tpuAccelerator: str = "tpu-v5-lite-podslice"
+    # Hosts in the TPU slice. 1 (default) renders the reference-shaped
+    # single-replica Deployment; N > 1 renders a StatefulSet + headless
+    # service spanning an N-host slice (e.g. 4 for v5e-16). This is the one
+    # deliberate addition to the reference's six-value surface: a KubeVirt
+    # VM can never span hosts, but a TPU slice payload can, and the
+    # resource *shape* (Deployment vs StatefulSet) must be decided at
+    # render time. See kvedge_tpu/render/manifests.py:runtime_statefulset.
+    tpuNumHosts: int = 1
 
     def validate(self) -> None:
         # Resource names must be RFC 1123 labels after the prefix is applied;
@@ -78,6 +89,13 @@ class ChartValues:
             raise ValueError(
                 f"tpuAccelerator {self.tpuAccelerator!r} is not a valid "
                 "node-selector value"
+            )
+        if not isinstance(self.tpuNumHosts, int) or isinstance(
+            self.tpuNumHosts, bool
+        ) or self.tpuNumHosts < 1:
+            raise ValueError(
+                f"tpuNumHosts must be a positive integer, got "
+                f"{self.tpuNumHosts!r}"
             )
 
     def replace(self, **kwargs) -> "ChartValues":
@@ -107,6 +125,11 @@ def parse_set_flag(values: ChartValues, assignment: str) -> ChartValues:
         if raw.lower() not in _BOOL_VALUES:
             raise ValueError(f"{key} expects true or false, got {raw!r}")
         parsed: object = _BOOL_VALUES[raw.lower()]
+    elif isinstance(current, int):
+        try:
+            parsed = int(raw)
+        except ValueError:
+            raise ValueError(f"{key} expects an integer, got {raw!r}") from None
     else:
         parsed = raw
     return values.replace(**{key: parsed})

@@ -183,6 +183,19 @@ class Chart:
         if func == "eq":
             a, b = args
             return a == b
+        if func == "ne":
+            a, b = args
+            return a != b
+        if func == "toString":
+            # sprig strval: fmt %v. Charts compare numeric values as
+            # strings because Helm's values pipeline yields float64 from
+            # values.yaml but int64 from --set — `eq`/`ne` on mixed Go
+            # numeric kinds is a render error, while toString normalizes
+            # both ("1"). Ints are ints here, so plain str() matches.
+            (v,) = args
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
         raise HelmLiteError(f"unsupported function {func!r}")
 
     _SENTINEL = object()

@@ -15,6 +15,12 @@ kvedge-tpu manifest                             reference template
 ``jax-tpu-runtime-service.yaml`` (conditional)  ``aziot-edge-vm-service.yaml``
 ==============================================  ================================
 
+With ``tpuNumHosts > 1`` the Deployment + PVC pair is replaced by
+``jax-tpu-runtime-multihost.yaml`` (a StatefulSet with per-host claim
+templates) plus ``jax-tpu-hosts-service.yaml`` (a headless service for
+per-ordinal DNS) — no reference analogue (the reference is single-VM by
+design, SURVEY.md §5); see :func:`runtime_statefulset`.
+
 The KubeVirt VM becomes a ``Deployment`` with ``replicas: 1`` and
 ``strategy: Recreate`` holding a ReadWriteOnce state PVC: on node failure the
 controller reschedules the pod and the PVC re-attaches — the same resilience
@@ -63,17 +69,22 @@ SSH_PORT = 22
 STATUS_PORT = RuntimeConfig.status_port
 
 
-def status_port(values: ChartValues) -> int:
-    """The status port the manifests must expose.
+def parsed_runtime_config(values: ChartValues) -> RuntimeConfig:
+    """The runtime config the opaque TOML value declares (defaults if empty).
 
-    Parsing the opaque runtime config here also validates it at render time
-    — a failure mode the reference only surfaced inside the booted VM
+    Parsing the opaque runtime config at render time also validates it — a
+    failure mode the reference only surfaced inside the booted VM
     (`iotedge config apply` failing post-install, `_helper.tpl:74`) fails
     the install command instead.
     """
     if not values.jaxRuntimeConfig:
-        return STATUS_PORT
-    port = RuntimeConfig.parse(values.jaxRuntimeConfig).status_port
+        return RuntimeConfig()
+    return RuntimeConfig.parse(values.jaxRuntimeConfig)
+
+
+def status_port(values: ChartValues) -> int:
+    """The status port the manifests must expose."""
+    port = parsed_runtime_config(values).status_port
     if port == 0:
         raise ValueError(
             "[status] port 0 (ephemeral) is only valid for local runs; "
@@ -293,6 +304,132 @@ def runtime_deployment(values: ChartValues) -> dict:
     }
 
 
+def hosts_service(values: ChartValues) -> dict:
+    """Headless Service giving multi-host pods stable per-ordinal DNS.
+
+    No reference analogue exists (the reference is explicitly single-VM,
+    SURVEY.md §5): this exists so StatefulSet pod N is reachable at
+    ``<name>-runtime-N.<name>-runtime-hosts`` before readiness — the
+    coordinator (pod 0) must be resolvable while every pod is still
+    blocked joining the JAX cluster, hence
+    ``publishNotReadyAddresses: true``. The advertised port follows the
+    config's ``[distributed] coordinator_port`` (like :func:`status_port`,
+    a custom port requires the Python renderer; the Helm chart pins the
+    default).
+    """
+    name = resource_name(values.nameOverride)
+    coordinator_port = parsed_runtime_config(values).distributed.coordinator_port
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "labels": common_labels(),
+            "name": f"{name}-runtime-hosts",
+        },
+        "spec": {
+            "clusterIP": "None",
+            "publishNotReadyAddresses": True,
+            "selector": {DOMAIN_LABEL: f"{name}-runtime"},
+            "ports": [
+                {
+                    "name": "coordinator",
+                    "protocol": "TCP",
+                    "port": coordinator_port,
+                }
+            ],
+        },
+    }
+
+
+def runtime_statefulset(values: ChartValues) -> dict:
+    """Multi-host variant of the runtime: one pod per slice host.
+
+    Same pod template as :func:`runtime_deployment` with the multi-host
+    deltas:
+
+    * ``kind: StatefulSet`` with ``replicas = tpuNumHosts`` and
+      ``podManagementPolicy: Parallel`` — ``jax.distributed.initialize``
+      blocks until *all* processes join, so pods must start together
+      (ordered startup would deadlock at pod 0);
+    * no ``hostname:`` override — StatefulSet pod hostnames are
+      ``<name>-runtime-<ordinal>``, which is exactly the identity
+      :mod:`kvedge_tpu.parallel.distributed` infers the process id from;
+    * ``KVEDGE_COORDINATOR`` env pointing at pod 0's stable headless-DNS
+      name (no port: the runtime appends ``[distributed]
+      coordinator_port``, so a custom port needs no re-render);
+    * per-host state PVCs via ``volumeClaimTemplates`` (a ReadWriteOnce
+      volume cannot span hosts). Heartbeats/boot counts are per-host;
+      multi-host *checkpoints* should point ``state_dir`` at shared
+      storage instead — the same honesty the reference applies to its
+      node-bound PVC (``README.md:88-89``).
+    """
+    name = resource_name(values.nameOverride)
+    doc = runtime_deployment(values)
+    doc["kind"] = "StatefulSet"
+    spec = doc["spec"]
+    spec["replicas"] = values.tpuNumHosts
+    del spec["strategy"]  # Recreate is a Deployment concept; RWO
+    # exclusivity is per-ordinal here (each pod owns its own claim).
+    spec["serviceName"] = f"{name}-runtime-hosts"
+    spec["podManagementPolicy"] = "Parallel"
+    pod = spec["template"]["spec"]
+    del pod["hostname"]
+    pod["containers"][0]["env"] = [
+        {
+            "name": "KVEDGE_COORDINATOR",
+            "value": f"{name}-runtime-0.{name}-runtime-hosts",
+        },
+        # The chart's topology, re-stated to the runtime so boot can refuse
+        # a TOML that silently disagrees (most dangerous case: a config
+        # with no [distributed] section at all would otherwise boot N
+        # healthy, independent single-host runtimes). Plain Helm cannot
+        # parse the TOML at install time, so this boot-time cross-check is
+        # the enforcement path for helm users.
+        {
+            "name": "KVEDGE_EXPECTED_PROCESSES",
+            "value": str(values.tpuNumHosts),
+        },
+    ]
+    pod["volumes"] = [v for v in pod["volumes"] if v["name"] != "statedisk"]
+    spec["volumeClaimTemplates"] = [
+        {
+            "metadata": {"name": "statedisk"},
+            "spec": {
+                "accessModes": ["ReadWriteOnce"],
+                "resources": {
+                    "requests": {"storage": values.tpuRuntimeDiskSize}
+                },
+            },
+        }
+    ]
+    return doc
+
+
+def _check_multihost_consistency(values: ChartValues) -> None:
+    """Fail the render when the chart shape and the TOML topology disagree.
+
+    The runtime would discover the mismatch only at boot (pods blocking in
+    ``jax.distributed.initialize`` or joining a cluster smaller than the
+    slice); the install-time failure is the same fast-fail divergence as
+    config validation (README "Deliberate divergences" #2).
+    """
+    config_procs = parsed_runtime_config(values).distributed.num_processes
+    if values.tpuNumHosts > 1 and config_procs != values.tpuNumHosts:
+        raise ValueError(
+            f"tpuNumHosts={values.tpuNumHosts} but the runtime config "
+            f"declares [distributed] num_processes={config_procs}; the "
+            "StatefulSet replica count and the JAX process group must "
+            "match (set num_processes in the config TOML)"
+        )
+    if values.tpuNumHosts == 1 and config_procs > 1:
+        raise ValueError(
+            f"runtime config declares [distributed] num_processes="
+            f"{config_procs} but tpuNumHosts=1; set "
+            f"--set tpuNumHosts={config_procs} to render the multi-host "
+            "StatefulSet"
+        )
+
+
 def access_service(values: ChartValues) -> dict | None:
     """Conditional LoadBalancer for external SSH + status access.
 
@@ -348,11 +485,12 @@ class RenderedChart:
 def render_notes(values: ChartValues) -> str:
     """Post-install usage text (reference: ``templates/NOTES.txt``)."""
     name = resource_name(values.nameOverride)
+    workload = "deployment" if values.tpuNumHosts == 1 else "statefulset"
     return (
         f"You have installed release {APP_VERSION} of {CHART_NAME}.\n"
         "\n"
         "To check the status of the newly created JAX TPU runtime, try:\n"
-        f"kubectl get deployment {name}-runtime\n"
+        f"kubectl get {workload} {name}-runtime\n"
         "\n"
         "To query the runtime status endpoint (once the pod is running):\n"
         f"curl http://$(kubectl get service {name}-runtime-ssh-service "
@@ -374,12 +512,19 @@ def render_all(values: ChartValues, include_dead: bool = False) -> RenderedChart
     were, its name would collide with the live state volume.
     """
     values.validate()
+    _check_multihost_consistency(values)
     manifests: dict[str, dict] = {
-        "jax-tpu-runtime.yaml": runtime_deployment(values),
-        "jax-tpu-state-volume.yaml": state_volume(values),
         "jax-tpu-runtime-config-secret.yaml": runtime_config_secret(values),
         "jax-tpu-boot-config-secret.yaml": boot_config_secret(values),
     }
+    if values.tpuNumHosts == 1:
+        manifests["jax-tpu-runtime.yaml"] = runtime_deployment(values)
+        manifests["jax-tpu-state-volume.yaml"] = state_volume(values)
+    else:
+        manifests["jax-tpu-runtime-multihost.yaml"] = (
+            runtime_statefulset(values)
+        )
+        manifests["jax-tpu-hosts-service.yaml"] = hosts_service(values)
     if include_dead:
         manifests["jax-tpu-state-volume-prepopulated.yaml"] = (
             state_volume_prepopulated(values)

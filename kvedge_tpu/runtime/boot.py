@@ -10,6 +10,7 @@ local verification.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 
 from kvedge_tpu.config.runtime_config import RuntimeConfig
@@ -69,6 +70,43 @@ def _degraded(error: str) -> DeviceCheckResult:
     )
 
 
+def _topology_mismatch(cfg: RuntimeConfig) -> str:
+    """Non-empty iff the chart topology and the config TOML disagree.
+
+    The multi-host chart re-states its replica count as
+    ``KVEDGE_EXPECTED_PROCESSES`` (render/manifests.py:runtime_statefulset);
+    plain Helm cannot parse the config TOML at install time, so this
+    boot-time check is what catches a TOML whose ``[distributed]`` section
+    is missing or wrong — otherwise N pods would boot as N healthy,
+    *independent* single-host runtimes and the misconfiguration would be
+    invisible.
+    """
+    expected_raw = os.environ.get("KVEDGE_EXPECTED_PROCESSES", "")
+    if not expected_raw:
+        return ""
+    try:
+        expected = int(expected_raw)
+    except ValueError:
+        return f"KVEDGE_EXPECTED_PROCESSES={expected_raw!r} is not an integer"
+    if expected != cfg.distributed.num_processes:
+        return (
+            f"topology mismatch: the chart was rendered for {expected} "
+            f"hosts (KVEDGE_EXPECTED_PROCESSES) but the runtime config "
+            f"declares [distributed] num_processes="
+            f"{cfg.distributed.num_processes}; fix the config TOML"
+        )
+    return ""
+
+
+def _booting() -> DeviceCheckResult:
+    """The pre-payload state served while boot work is still in flight."""
+    return DeviceCheckResult(
+        ok=False, platform="booting", device_count=0, device_kinds=(),
+        mesh_axes=(), mesh_shape=(), probe_ms=0.0, probe_checksum=0.0,
+        error="boot in progress (multi-host join / payload not finished)",
+    )
+
+
 def _run_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     if cfg.payload == "none":
         return DeviceCheckResult(
@@ -86,24 +124,20 @@ def _run_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
 
 
 def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
-    """Run the payload once, then start the heartbeat loop + status server."""
+    """Start the status server, run the boot work, keep the heartbeat going.
+
+    The status server starts FIRST, serving the ``booting`` state, because
+    the boot work can block for minutes: a multi-host join waits for every
+    pod in the slice, and the first payload compile is slow. If the server
+    only came up afterwards, kubelet's liveness probe (which targets
+    /version) would kill and restart the pod mid-join — precisely the
+    crash-loop the degraded-state design exists to avoid.
+    """
     started_at = time.time()
     boot_count = heartbeat.next_boot_count(cfg.state_dir)
 
-    # Multi-host: join the cross-host JAX cluster BEFORE the payload, so
-    # jax.devices() sees the whole slice. A join failure degrades the pod
-    # (status stays queryable) instead of crash-looping it.
+    check = _booting()
     dist = DistributedState(active=False)
-    try:
-        dist = maybe_initialize(cfg.distributed)
-    except Exception as e:
-        check = _degraded(
-            f"multi-host join failed "
-            f"(num_processes={cfg.distributed.num_processes}): {e!r}"
-        )
-    else:
-        check = _run_payload(cfg)
-
     handle: RuntimeHandle = None  # assigned below; closures capture it
 
     def build_heartbeat() -> dict:
@@ -129,6 +163,26 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
     )
     writer.beat_once()  # heartbeat visible before the server answers
     server.start()
+
+    # Multi-host: join the cross-host JAX cluster BEFORE the payload, so
+    # jax.devices() sees the whole slice. A join failure degrades the pod
+    # (status stays queryable) instead of crash-looping it.
+    topo_error = _topology_mismatch(cfg)
+    if topo_error:
+        check = _degraded(topo_error)
+    else:
+        try:
+            dist = maybe_initialize(cfg.distributed)
+        except Exception as e:
+            check = _degraded(
+                f"multi-host join failed "
+                f"(num_processes={cfg.distributed.num_processes}): {e!r}"
+            )
+        else:
+            check = _run_payload(cfg)
+    handle.check = check
+    handle.distributed = dist
+    writer.beat_once()  # refresh: the booting heartbeat is now stale
     return handle
 
 

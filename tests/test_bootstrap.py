@@ -115,3 +115,19 @@ def test_subprocess_extension_command(tmp_path):
     assert marker.exists()
     with pytest.raises(CommandError, match="exited with"):
         run_command(("false",), root=str(tmp_path))
+
+
+def test_locate_with_relative_root_resolves(tmp_path, monkeypatch):
+    """`entrypoint --root .` must work: a relative search root produced a
+    symlink with a relative target, which resolves against the link's own
+    directory (mnt/) instead of the cwd — a dangling link."""
+    from kvedge_tpu.bootstrap import mount
+
+    (tmp_path / "mnt/disks/SER123").mkdir(parents=True)
+    (tmp_path / "mnt/disks/SER123/userdata").write_text("x = 1\n")
+    monkeypatch.chdir(tmp_path)
+    resolved = mount.locate(
+        serial="SER123", search_root="./mnt/disks", link="./mnt/app-secret"
+    )
+    assert (tmp_path / "mnt/app-secret/userdata").read_text() == "x = 1\n"
+    assert resolved == str(tmp_path / "mnt/disks/SER123")

@@ -29,6 +29,10 @@ VALUE_MATRIX = [
     # reference bit (aziot-edge-vm.yaml:57); both renderers must fall back
     # to the chart name consistently.
     {"nameOverride": ""},
+    # Multi-host: Deployment+PVC swap out for StatefulSet + headless
+    # service in BOTH renderers.
+    {"tpuNumHosts": 4,
+     "jaxRuntimeConfig": "[distributed]\nnum_processes = 4\n"},
 ]
 
 
@@ -71,6 +75,15 @@ def test_boot_config_secret_byte_identical(chart, overrides):
 def test_notes_match(chart):
     rendered = chart.render({})
     assert rendered["NOTES.txt"] == render_notes(DEFAULT_VALUES)
+
+
+def test_notes_match_multihost(chart):
+    overrides = {"tpuNumHosts": 4,
+                 "jaxRuntimeConfig": "[distributed]\nnum_processes = 4\n"}
+    rendered = chart.render(overrides)
+    assert rendered["NOTES.txt"] == render_notes(
+        DEFAULT_VALUES.replace(**overrides)
+    )
 
 
 def test_dead_template_is_helmignored(chart):

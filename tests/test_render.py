@@ -181,3 +181,82 @@ def test_probes_use_version_not_healthz():
     for probe in ("livenessProbe", "readinessProbe"):
         assert container[probe]["httpGet"]["path"] == "/version"
         assert container[probe]["httpGet"]["port"] == "status"
+
+
+MULTIHOST_TOML = "[distributed]\nnum_processes = 4\n"
+MULTIHOST = DEFAULT_VALUES.replace(tpuNumHosts=4, jaxRuntimeConfig=MULTIHOST_TOML)
+
+
+def test_multihost_render_swaps_workload_and_adds_hosts_service():
+    chart = render_all(MULTIHOST)
+    assert set(chart.manifests) == {
+        "jax-tpu-runtime-multihost.yaml",
+        "jax-tpu-hosts-service.yaml",
+        "jax-tpu-runtime-config-secret.yaml",
+        "jax-tpu-boot-config-secret.yaml",
+        "jax-tpu-runtime-service.yaml",
+    }
+    sts = chart.manifests["jax-tpu-runtime-multihost.yaml"]
+    assert sts["kind"] == "StatefulSet"
+    spec = sts["spec"]
+    assert spec["replicas"] == 4
+    assert spec["podManagementPolicy"] == "Parallel"
+    assert spec["serviceName"] == "kvedge-tpu-runtime-hosts"
+    pod = spec["template"]["spec"]
+    # StatefulSet pod hostnames carry the ordinal the runtime infers its
+    # process id from — a hostname override would erase that identity.
+    assert "hostname" not in pod
+    env = {e["name"]: e["value"] for e in pod["containers"][0]["env"]}
+    assert env["KVEDGE_COORDINATOR"] == (
+        "kvedge-tpu-runtime-0.kvedge-tpu-runtime-hosts"
+    )
+    # State is per-host claims, not one shared RWO volume.
+    assert [v["name"] for v in pod["volumes"]] == [
+        "jaxconfigdisk", "bootconfigdisk",
+    ]
+    claims = spec["volumeClaimTemplates"]
+    assert claims[0]["metadata"]["name"] == "statedisk"
+    assert claims[0]["spec"]["resources"]["requests"]["storage"] == "4Gi"
+
+
+def test_multihost_hosts_service_is_headless_and_unready_tolerant():
+    chart = render_all(MULTIHOST)
+    svc = chart.manifests["jax-tpu-hosts-service.yaml"]
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["spec"]["publishNotReadyAddresses"] is True
+    assert svc["spec"]["ports"][0]["port"] == 8478
+
+
+def test_multihost_coordinator_port_follows_config():
+    toml = "[distributed]\nnum_processes = 2\ncoordinator_port = 9100\n"
+    chart = render_all(DEFAULT_VALUES.replace(
+        tpuNumHosts=2, jaxRuntimeConfig=toml
+    ))
+    svc = chart.manifests["jax-tpu-hosts-service.yaml"]
+    assert svc["spec"]["ports"][0]["port"] == 9100
+
+
+def test_multihost_topology_mismatch_fails_at_render():
+    # Chart shape and TOML process group must agree, both ways.
+    with pytest.raises(ValueError, match="num_processes"):
+        render_all(DEFAULT_VALUES.replace(
+            tpuNumHosts=4, jaxRuntimeConfig="[distributed]\nnum_processes = 2\n"
+        ))
+    with pytest.raises(ValueError, match="num_processes"):
+        render_all(DEFAULT_VALUES.replace(tpuNumHosts=4))  # config says 1
+    with pytest.raises(ValueError, match="tpuNumHosts"):
+        render_all(DEFAULT_VALUES.replace(jaxRuntimeConfig=MULTIHOST_TOML))
+
+
+def test_multihost_notes_name_statefulset():
+    notes = render_notes(MULTIHOST)
+    assert "kubectl get statefulset kvedge-tpu-runtime" in notes
+    assert "deployment" not in notes
+
+
+def test_multihost_pods_receive_expected_processes_env():
+    chart = render_all(MULTIHOST)
+    sts = chart.manifests["jax-tpu-runtime-multihost.yaml"]
+    env = {e["name"]: e["value"]
+           for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["KVEDGE_EXPECTED_PROCESSES"] == "4"

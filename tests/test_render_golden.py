@@ -1,32 +1,53 @@
-"""Golden-file snapshots of the default render.
+"""Golden-file snapshots of the default and multi-host renders.
 
 The analogue of `helm template` snapshot testing (SURVEY.md §4 implication).
 Regenerate after an intentional template change with:
 
     python -m kvedge_tpu render --golden tests/golden/default
+    python -m kvedge_tpu render --set tpuNumHosts=4 \
+        --set $'jaxRuntimeConfig=[distributed]\nnum_processes = 4\n' \
+        --golden tests/golden/multihost
+
+(the $'...' quoting makes the shell expand the \n escapes — a plain
+'...' would pass literal backslash-n, which is invalid TOML).
 """
 
 import pathlib
+
+import pytest
 
 from kvedge_tpu.config.values import DEFAULT_VALUES
 from kvedge_tpu.render import render_all, to_yaml
 from kvedge_tpu.render.manifests import render_notes
 
-GOLDEN_DIR = pathlib.Path(__file__).parent / "golden" / "default"
+GOLDEN_ROOT = pathlib.Path(__file__).parent / "golden"
+
+CASES = {
+    "default": DEFAULT_VALUES,
+    "multihost": DEFAULT_VALUES.replace(
+        tpuNumHosts=4,
+        jaxRuntimeConfig="[distributed]\nnum_processes = 4\n",
+    ),
+}
 
 
-def test_golden_filenames():
-    chart = render_all(DEFAULT_VALUES)
-    expected = {p.name for p in GOLDEN_DIR.glob("*.yaml")}
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_golden_filenames(case):
+    chart = render_all(CASES[case])
+    expected = {p.name for p in (GOLDEN_ROOT / case).glob("*.yaml")}
     assert set(chart.manifests) == expected
 
 
-def test_golden_bytes():
-    chart = render_all(DEFAULT_VALUES)
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_golden_bytes(case):
+    chart = render_all(CASES[case])
     for filename, doc in chart.ordered():
-        golden = (GOLDEN_DIR / filename).read_text()
-        assert to_yaml(doc) == golden, f"golden mismatch: {filename}"
+        golden = (GOLDEN_ROOT / case / filename).read_text()
+        assert to_yaml(doc) == golden, f"golden mismatch: {case}/{filename}"
 
 
-def test_golden_notes():
-    assert render_notes(DEFAULT_VALUES) == (GOLDEN_DIR / "NOTES.txt").read_text()
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_golden_notes(case):
+    assert render_notes(CASES[case]) == (
+        GOLDEN_ROOT / case / "NOTES.txt"
+    ).read_text()

@@ -58,7 +58,11 @@ def test_heartbeat_boot_count_survives_restart(tmp_path):
     try:
         assert handle.boot_count == 1
         beat = heartbeat.read_heartbeat(state)
-        assert beat["boot_count"] == 1 and beat["seq"] == 1
+        # Each boot beats twice: once in the pre-payload `booting` state
+        # (so the heartbeat exists even while a multi-host join blocks) and
+        # once when the payload result lands.
+        assert beat["boot_count"] == 1 and beat["seq"] == 2
+        assert beat["ok"] is True  # the final beat, not the booting one
     finally:
         handle.shutdown()
     # "Reschedule": new runtime, same state dir — the PVC persistence story.
@@ -67,7 +71,7 @@ def test_heartbeat_boot_count_survives_restart(tmp_path):
         assert handle.boot_count == 2
         beat = heartbeat.read_heartbeat(state)
         assert beat["boot_count"] == 2
-        assert beat["seq"] == 2  # seq continues, state survived
+        assert beat["seq"] == 4  # seq continues, state survived
     finally:
         handle.shutdown()
 
@@ -215,3 +219,66 @@ def test_transformer_probe_ring_on_seq_mesh(tmp_path):
     assert result.ok, result.error
     assert result.mesh_shape == (2, 4)
     assert math.isfinite(result.probe_checksum)
+
+
+def test_status_server_answers_during_boot_work(tmp_path, monkeypatch):
+    """The server must serve /version while the boot work is in flight.
+
+    Kubelet's liveness probe targets /version; a multi-host join or first
+    compile can block for minutes, and if the server only started after,
+    the probe would kill the pod mid-join (crash-loop). The payload stands
+    in for the blocking work and probes the server itself.
+    """
+    import urllib.error
+
+    from kvedge_tpu.runtime import boot as boot_mod
+    from kvedge_tpu.runtime.devicecheck import DeviceCheckResult
+
+    port = 8791  # fixed: the payload must know it before the handle exists
+
+    def probing_payload(cfg):
+        code, _ = _get(port, "/version")
+        try:  # /healthz must be 503 while still booting
+            _get(port, "/healthz")
+            hz = 200
+        except urllib.error.HTTPError as e:
+            hz = e.code
+        ok = code == 200 and hz == 503
+        return DeviceCheckResult(
+            ok=ok, platform="probe", device_count=0, device_kinds=(),
+            mesh_axes=(), mesh_shape=(), probe_ms=0.0, probe_checksum=0.0,
+            error="" if ok else f"version={code} healthz={hz}",
+        )
+
+    monkeypatch.setattr(boot_mod, "_run_payload", probing_payload)
+    handle = start_runtime(_cfg(tmp_path, status_port=port))
+    try:
+        assert handle.check.ok, handle.check.error
+        # After boot completes the same server flips healthy.
+        code, _ = _get(port, "/healthz")
+        assert code == 200
+    finally:
+        handle.shutdown()
+
+
+def test_boot_refuses_chart_config_topology_mismatch(tmp_path, monkeypatch):
+    """The multi-host chart re-states its host count via env; a config TOML
+    that disagrees (e.g. forgot [distributed] entirely) must degrade the
+    pod, not boot a healthy-looking independent single-host runtime."""
+    monkeypatch.setenv("KVEDGE_EXPECTED_PROCESSES", "4")
+    handle = start_runtime(_cfg(tmp_path))  # config says num_processes=1
+    try:
+        assert not handle.check.ok
+        assert "topology mismatch" in handle.check.error
+        assert "num_processes=1" in handle.check.error
+    finally:
+        handle.shutdown()
+
+
+def test_boot_accepts_matching_topology_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("KVEDGE_EXPECTED_PROCESSES", "1")
+    handle = start_runtime(_cfg(tmp_path))
+    try:
+        assert handle.check.ok, handle.check.error
+    finally:
+        handle.shutdown()

@@ -12,10 +12,24 @@ from kvedge_tpu.config.values import (
 )
 
 
-def test_exactly_six_values():
-    # The reference's config surface is exactly six values (values.yaml:1-17);
-    # parity check against SURVEY.md §2 #2.
-    assert len(dataclasses.fields(ChartValues)) == 6
+def test_exactly_seven_values():
+    # The reference's config surface is exactly six values (values.yaml:1-17;
+    # parity check against SURVEY.md §2 #2) plus the one documented addition,
+    # tpuNumHosts — the multi-host switch the single-VM reference cannot
+    # express (see the ChartValues field comment).
+    assert len(dataclasses.fields(ChartValues)) == 7
+
+
+def test_num_hosts_validation_and_parse():
+    with pytest.raises(ValueError, match="tpuNumHosts"):
+        ChartValues(tpuNumHosts=0).validate()
+    with pytest.raises(ValueError, match="tpuNumHosts"):
+        ChartValues(tpuNumHosts=True).validate()  # bools are not counts
+    ChartValues(tpuNumHosts=4).validate()
+    v = parse_set_flag(DEFAULT_VALUES, "tpuNumHosts=4")
+    assert v.tpuNumHosts == 4
+    with pytest.raises(ValueError, match="integer"):
+        parse_set_flag(DEFAULT_VALUES, "tpuNumHosts=four")
 
 
 def test_defaults_mirror_reference():
