@@ -31,7 +31,9 @@ def _toml_str(value: str) -> str:
 DEFAULT_CONFIG_PATH = "/etc/kvedge/config.toml"
 DEFAULT_STATE_DIR = "/var/lib/kvedge/state"
 
-_VALID_PAYLOADS = ("devicecheck", "transformer-probe", "none")
+_VALID_PAYLOADS = (
+    "devicecheck", "transformer-probe", "inference-probe", "none",
+)
 
 
 class RuntimeConfigError(ValueError):

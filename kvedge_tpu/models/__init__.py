@@ -15,6 +15,14 @@ from kvedge_tpu.models.transformer import (
     loss_fn,
     make_train_step,
 )
+from kvedge_tpu.models.decode import (
+    KVCache,
+    init_cache,
+    prefill,
+    decode_step,
+    generate,
+)
+from kvedge_tpu.models.kvcache import PagedKVCache, PagedCacheError
 
 __all__ = [
     "TransformerConfig",
@@ -22,4 +30,11 @@ __all__ = [
     "forward",
     "loss_fn",
     "make_train_step",
+    "KVCache",
+    "init_cache",
+    "prefill",
+    "decode_step",
+    "generate",
+    "PagedKVCache",
+    "PagedCacheError",
 ]

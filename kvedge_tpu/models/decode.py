@@ -1,0 +1,182 @@
+"""Autoregressive inference: prefill + decode with a static-shape KV cache.
+
+The reference has no inference path (its payload is an opaque external
+daemon, SURVEY.md §0); this module is the serving half of kvedge-tpu's
+flagship payload, designed TPU-first:
+
+* **Static shapes.** The cache is allocated once at ``[L, B, S, K, Dh]``
+  and written in place with ``lax.dynamic_update_slice``; the decode loop
+  is a ``lax.scan`` over steps — one compiled step regardless of length,
+  no retracing as the sequence grows.
+* **Donated cache.** ``decode_step`` donates the cache buffers, so XLA
+  performs the slice-update in place instead of copying HBM every token.
+* **GQA-aware.** K/V are cached at ``cfg.kv_heads`` — with grouped-query
+  attention the cache (the HBM-bandwidth bill of decoding) shrinks by
+  ``n_heads / n_kv_heads``. Attention against the cache uses a grouped
+  einsum; the KV repeat is never materialized.
+* **fp32 softmax, bf16 everything else** — same numerics policy as
+  training (transformer.py).
+
+The per-step layer loop is the same ``lax.scan``-over-stacked-params scheme
+as the forward pass: each layer's cache slab rides the scan's xs/ys, so XLA
+compiles ONE layer body and, with donation, updates slabs in place.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kvedge_tpu.models.transformer import (
+    TransformerConfig,
+    _rmsnorm,
+    _rotary,
+    split_qkv,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Contiguous KV cache: one [L, B, S, K, Dh] slab per projection.
+
+    ``length`` is the number of valid positions (traced; uniform across the
+    batch — ragged batches are the paged cache's job, models/kvcache.py).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # scalar int32
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(cfg: TransformerConfig, batch: int,
+               max_seq: int | None = None) -> KVCache:
+    cfg.validate()
+    shape = (
+        cfg.n_layers, batch, max_seq or cfg.max_seq, cfg.kv_heads, cfg.d_head,
+    )
+    dtype = jnp.dtype(cfg.dtype)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _attend_layer(cfg: TransformerConfig, x, layer_params, k_slab, v_slab,
+                  pos):
+    """One decoder block against the cache.
+
+    x: [B, Q, D] new positions starting at ``pos``; k_slab/v_slab:
+    [B, S, K, Dh] this layer's cache. Returns (x, k_slab, v_slab) with the
+    new positions written in. Works for prefill (Q = prompt len, pos = 0)
+    and decode (Q = 1) alike.
+    """
+    w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
+    batch, q_len, _ = x.shape
+    h, kv, dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
+    group = h // kv
+    max_seq = k_slab.shape[1]
+    dtype = x.dtype
+
+    normed = _rmsnorm(x, ln_attn)
+    q, k, v = split_qkv(cfg, normed @ w_qkv.astype(dtype))
+    positions = pos + jnp.arange(q_len)
+    q = _rotary(q, positions)
+    k = _rotary(k, positions)
+
+    k_slab = lax.dynamic_update_slice(k_slab, k, (0, pos, 0, 0))
+    v_slab = lax.dynamic_update_slice(v_slab, v, (0, pos, 0, 0))
+
+    # Grouped attention against the whole slab; invalid tail positions are
+    # masked out. q grouped as [B, Q, K, G, Dh] so each KV head serves its
+    # G query heads without materializing a repeat.
+    qg = q.reshape(batch, q_len, kv, group, dh)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_slab) / (dh ** 0.5)
+    key_pos = jnp.arange(max_seq)
+    allowed = key_pos[None, :] <= positions[:, None]  # [Q, S] causal+valid
+    scores = jnp.where(
+        allowed[None, None, None], scores, jnp.finfo(dtype).min
+    )
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    attended = jnp.einsum("bkgqs,bskd->bqkgd", weights, v_slab)
+    x = x + attended.reshape(batch, q_len, h * dh) @ w_out.astype(dtype)
+
+    normed = _rmsnorm(x, ln_mlp)
+    x = x + jax.nn.gelu(normed @ w_up.astype(dtype)) @ w_down.astype(dtype)
+    return x, k_slab, v_slab
+
+
+def _stacked(params: dict):
+    return (
+        params["w_qkv"], params["w_out"], params["w_up"], params["w_down"],
+        params["ln_attn"], params["ln_mlp"],
+    )
+
+
+def _run_layers(cfg: TransformerConfig, params: dict, x, cache: KVCache, pos):
+    """Scan the layer stack, threading each layer's cache slab through xs/ys."""
+
+    def body(carry, xs):
+        layer_params, k_slab, v_slab = xs
+        out, k_slab, v_slab = _attend_layer(
+            cfg, carry, layer_params, k_slab, v_slab, pos
+        )
+        return out, (k_slab, v_slab)
+
+    x, (new_k, new_v) = lax.scan(body, x, (_stacked(params), cache.k, cache.v))
+    x = _rmsnorm(x, params["ln_final"])
+    logits = x[:, -1].astype(jnp.float32) @ params["embedding"].T
+    new_cache = KVCache(k=new_k, v=new_v, length=pos + x.shape[1])
+    return logits, new_cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def prefill(params: dict, tokens, cache: KVCache, cfg: TransformerConfig):
+    """Feed a [B, T] prompt into an empty cache.
+
+    Returns (last-position logits [B, V] fp32, filled cache).
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embedding"][tokens].astype(dtype)
+    return _run_layers(cfg, params, x, cache, jnp.zeros((), jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def decode_step(params: dict, cache: KVCache, tokens, cfg: TransformerConfig):
+    """One decode step: [B] tokens at position ``cache.length``.
+
+    Returns (logits [B, V] fp32, cache advanced by one).
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embedding"][tokens][:, None].astype(dtype)  # [B, 1, D]
+    return _run_layers(cfg, params, x, cache, cache.length)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_new"))
+def generate(params: dict, prompt, cfg: TransformerConfig, n_new: int):
+    """Greedy-decode ``n_new`` tokens after a [B, T] prompt.
+
+    Returns [B, T + n_new] int32. The whole loop is one compiled program:
+    prefill, then a ``lax.scan`` of donated decode steps.
+    """
+    batch, prompt_len = prompt.shape
+    cache = init_cache(cfg, batch, max_seq=prompt_len + n_new)
+    logits, cache = prefill(params, prompt, cache, cfg)
+
+    def step(carry, _):
+        cache, logits = carry
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits, cache = decode_step(params, cache, token, cfg)
+        return (cache, logits), token
+
+    (_, _), tokens = lax.scan(step, (cache, logits), None, length=n_new)
+    return jnp.concatenate([prompt, tokens.T], axis=1)

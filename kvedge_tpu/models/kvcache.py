@@ -1,0 +1,336 @@
+"""Paged KV cache: fixed-size pages + block tables for ragged serving.
+
+The contiguous cache (models/decode.py) assumes one uniform-length batch.
+Serving wants many sequences of different lengths sharing one memory pool —
+the paged-attention scheme: K/V live in fixed-size **pages** out of a global
+pool, and each sequence owns an ordered **block table** of page indices.
+Admitting a sequence allocates pages; finishing one frees them; fragmentation
+is bounded by the page size.
+
+TPU-first shape discipline:
+
+* The pool ``[L, P, page, K, Dh]`` and block tables ``[B, max_pages]`` are
+  **static**; growth happens by table entries, never by reshaping arrays —
+  nothing retraces as sequences come and go.
+* The per-step gather (``pool[tables]``) and scatter (one page row per
+  sequence) are batched ``take``/``scatter`` ops XLA lowers to dynamic
+  gathers — no per-sequence Python.
+* Allocation policy (free lists, admission) is host-side Python — it is
+  control plane, runs once per request, and must not live inside ``jit``.
+
+Attention math (grouped einsum, fp32 softmax) matches decode.py exactly, so
+paged and contiguous decoding agree bit-for-bit on the same prompts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kvedge_tpu.models.transformer import (
+    TransformerConfig,
+    _rmsnorm,
+    _rotary,
+    split_qkv,
+)
+from kvedge_tpu.models.decode import _stacked
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedState:
+    """Device-side paged cache state (a pytree; host policy lives in
+    :class:`PagedKVCache`)."""
+
+    pool_k: jax.Array   # [L, P, page, K, Dh]
+    pool_v: jax.Array   # [L, P, page, K, Dh]
+    tables: jax.Array   # [B, max_pages] int32 page ids (0 = also a real page;
+                        # entries past a sequence's page count are unused)
+    lengths: jax.Array  # [B] int32 valid positions per sequence
+
+    @property
+    def page_size(self) -> int:
+        return self.pool_k.shape[2]
+
+    @property
+    def max_seq(self) -> int:
+        return self.tables.shape[1] * self.page_size
+
+
+class PagedCacheError(RuntimeError):
+    pass
+
+
+class PagedKVCache:
+    """Host-side pool manager wrapping a :class:`PagedState`.
+
+    ``slots`` is the max concurrent sequences (the batch dim of every step).
+    Unused slots keep ``lengths == 0`` and are masked out of attention.
+    """
+
+    def __init__(self, cfg: TransformerConfig, *, slots: int, pages: int,
+                 page_size: int = 16, max_pages_per_seq: int | None = None):
+        cfg.validate()
+        self.cfg = cfg
+        self.slots = slots
+        self.page_size = page_size
+        self.max_pages_per_seq = (
+            max_pages_per_seq or -(-cfg.max_seq // page_size)
+        )
+        dtype = jnp.dtype(cfg.dtype)
+        shape = (cfg.n_layers, pages, page_size, cfg.kv_heads, cfg.d_head)
+        self.state = PagedState(
+            pool_k=jnp.zeros(shape, dtype),
+            pool_v=jnp.zeros(shape, dtype),
+            tables=jnp.zeros((slots, self.max_pages_per_seq), jnp.int32),
+            lengths=jnp.zeros((slots,), jnp.int32),
+        )
+        self._free: list[int] = list(range(pages))[::-1]  # pop() -> lowest last
+        self._pages_of: dict[int, list[int]] = {}
+        self._host_tables = [
+            [0] * self.max_pages_per_seq for _ in range(slots)
+        ]
+        self._host_lengths = [0] * slots
+
+    # ---- control plane (host) -------------------------------------------
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def admit(self, slot: int, prompt_len: int) -> None:
+        """Reserve pages for a prompt landing in ``slot``."""
+        if slot in self._pages_of:
+            raise PagedCacheError(f"slot {slot} already admitted")
+        needed = -(-prompt_len // self.page_size) or 1
+        if needed > self.max_pages_per_seq:
+            raise PagedCacheError(
+                f"prompt of {prompt_len} needs {needed} pages > "
+                f"max_pages_per_seq={self.max_pages_per_seq}"
+            )
+        if needed > len(self._free):
+            raise PagedCacheError(
+                f"pool exhausted: need {needed} pages, {len(self._free)} free"
+            )
+        self._pages_of[slot] = [self._free.pop() for _ in range(needed)]
+        row = self._host_tables[slot]
+        for i, page in enumerate(self._pages_of[slot]):
+            row[i] = page
+        self._host_lengths[slot] = prompt_len
+        self._sync()
+
+    def grow(self, slot: int) -> None:
+        """Ensure the slot can hold one more token (allocating if at a
+        page boundary). Called by :meth:`step` — not usually directly."""
+        if slot not in self._pages_of:
+            raise PagedCacheError(f"slot {slot} is not admitted")
+        length = self._host_lengths[slot]
+        pages = self._pages_of[slot]
+        if length + 1 > len(pages) * self.page_size:
+            if len(pages) == self.max_pages_per_seq:
+                raise PagedCacheError(f"slot {slot} hit max_pages_per_seq")
+            if not self._free:
+                raise PagedCacheError("pool exhausted mid-decode")
+            page = self._free.pop()
+            pages.append(page)
+            self._host_tables[slot][len(pages) - 1] = page
+
+    def release(self, slot: int) -> None:
+        """Finish a sequence: return its pages to the pool."""
+        if slot not in self._pages_of:
+            raise PagedCacheError(f"slot {slot} is not admitted")
+        for page in self._pages_of.pop(slot):
+            self._free.append(page)
+        self._host_tables[slot] = [0] * self.max_pages_per_seq
+        self._host_lengths[slot] = 0
+        self._sync()
+
+    def _sync(self) -> None:
+        self.state = dataclasses.replace(
+            self.state,
+            tables=jnp.asarray(self._host_tables, jnp.int32),
+            lengths=jnp.asarray(self._host_lengths, jnp.int32),
+        )
+
+    # ---- data plane (device) --------------------------------------------
+
+    def prefill(self, params: dict, slot: int, prompt) -> jax.Array:
+        """Feed a 1D prompt into ``slot`` (after :meth:`admit`).
+
+        Prefill is per-sequence (prompts arrive one request at a time in
+        serving); the batched hot path is :meth:`step`. Returns the
+        last-position logits [V].
+        """
+        (prompt_len,) = prompt.shape
+        if prompt_len != self._host_lengths[slot]:
+            raise PagedCacheError(
+                f"admit({slot}) reserved {self._host_lengths[slot]} positions, "
+                f"prefill got {prompt_len}"
+            )
+        logits, self.state = _paged_prefill(
+            params, self.state, prompt, slot, self.cfg
+        )
+        return logits
+
+    def step(self, params: dict, tokens) -> jax.Array:
+        """One batched decode step over every active slot.
+
+        ``tokens`` is [slots] int32; inactive slots' outputs are garbage
+        (masked sequences) and their lengths do not advance. Returns
+        logits [slots, V].
+        """
+        active = [s for s in self._pages_of]
+        for slot in active:
+            self.grow(slot)
+        self._sync()
+        logits, self.state = _paged_decode_step(
+            params, self.state, tokens, self.cfg
+        )
+        # The device state already advanced active slots' lengths (the
+        # active mask in _paged_decode_step); just mirror on the host —
+        # tables only change in admit/grow/release, which sync themselves.
+        for slot in active:
+            self._host_lengths[slot] += 1
+        return logits
+
+
+# ---- jitted kernels ------------------------------------------------------
+
+
+def _gathered(state: PagedState, layer_slabs):
+    """pool[L] pages -> per-sequence contiguous [B, S_max, K, Dh] views."""
+    pool_k_l, pool_v_l = layer_slabs  # [P, page, K, Dh]
+    batch, max_pages = state.tables.shape
+    page, kv, dh = pool_k_l.shape[1:]
+    k = pool_k_l[state.tables]  # [B, max_pages, page, K, Dh]
+    v = pool_v_l[state.tables]
+    return (
+        k.reshape(batch, max_pages * page, kv, dh),
+        v.reshape(batch, max_pages * page, kv, dh),
+    )
+
+
+def _scatter_token(pool, tables, lengths, kv_new, active):
+    """Write one [B, K, Dh] token row into each sequence's current page.
+
+    pool [P, page, K, Dh]; the target of row b is
+    page ``tables[b, lengths[b] // page]``, offset ``lengths[b] % page``.
+    Inactive slots (empty table rows would alias page 0) are routed
+    out-of-bounds and dropped.
+    """
+    pages, page = pool.shape[:2]
+    page_idx = jnp.take_along_axis(
+        tables, (lengths // page)[:, None], axis=1
+    )[:, 0]                                   # [B] page ids
+    page_idx = jnp.where(active, page_idx, pages)  # OOB => dropped
+    offset = lengths % page                    # [B]
+    return pool.at[page_idx, offset].set(kv_new, mode="drop")
+
+
+def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
+                        layer_params, layer_slabs, q_positions, slot=None):
+    """Shared block body. x: [B, Q, D]; q_positions: [B, Q] absolute
+    positions of the new tokens. ``slot`` non-None = single-sequence
+    prefill (B == 1 view of that slot)."""
+    w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
+    batch, q_len, _ = x.shape
+    h, kv, dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
+    group = h // kv
+    dtype = x.dtype
+    pool_k_l, pool_v_l = layer_slabs
+
+    normed = _rmsnorm(x, ln_attn)
+    q, k, v = split_qkv(cfg, normed @ w_qkv.astype(dtype))
+    # rotary wants [T]-shaped positions; rows share a position vector only
+    # in prefill (B=1). For decode q_len == 1: apply per-row via vmap.
+    if q_len == 1:
+        rot = jax.vmap(lambda t, p: _rotary(t[None], p)[0])
+        q = rot(q, q_positions)
+        k = rot(k, q_positions)
+    else:
+        q = _rotary(q, q_positions[0])
+        k = _rotary(k, q_positions[0])
+
+    if slot is None:
+        tables, lengths = state.tables, state.lengths
+        active = lengths > 0
+        new_pool_k = _scatter_token(pool_k_l, tables, lengths, k[:, 0], active)
+        new_pool_v = _scatter_token(pool_v_l, tables, lengths, v[:, 0], active)
+    else:
+        # Prefill: scatter q_len rows of one slot. Positions are
+        # 0..q_len-1 because admit() starts the sequence at zero.
+        tables = state.tables[slot][None]
+        page = pool_k_l.shape[1]
+        positions = q_positions[0]
+        page_idx = tables[0][positions // page]
+        offset = positions % page
+        new_pool_k = pool_k_l.at[page_idx, offset].set(k[0])
+        new_pool_v = pool_v_l.at[page_idx, offset].set(v[0])
+
+    gk, gv = _gathered(
+        dataclasses.replace(state, tables=tables),
+        (new_pool_k, new_pool_v),
+    )
+    qg = q.reshape(batch, q_len, kv, group, dh)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, gk) / (dh ** 0.5)
+    key_pos = jnp.arange(gk.shape[1])
+    allowed = key_pos[None, None, :] <= q_positions[:, :, None]  # [B, Q, S]
+    scores = jnp.where(
+        allowed[:, None, None], scores, jnp.finfo(dtype).min
+    )
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    attended = jnp.einsum("bkgqs,bskd->bqkgd", weights, gv)
+    x = x + attended.reshape(batch, q_len, h * dh) @ w_out.astype(dtype)
+
+    normed = _rmsnorm(x, ln_mlp)
+    x = x + jax.nn.gelu(normed @ w_up.astype(dtype)) @ w_down.astype(dtype)
+    return x, new_pool_k, new_pool_v
+
+
+def _run_paged(cfg, params, state, x, q_positions, slot=None):
+    def body(carry, xs):
+        layer_params, pool_k_l, pool_v_l = xs
+        out, pool_k_l, pool_v_l = _paged_attend_layer(
+            cfg, state, carry, layer_params, (pool_k_l, pool_v_l),
+            q_positions, slot,
+        )
+        return out, (pool_k_l, pool_v_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (_stacked(params), state.pool_k, state.pool_v)
+    )
+    x = _rmsnorm(x, params["ln_final"])
+    logits = x[:, -1].astype(jnp.float32) @ params["embedding"].T
+    return logits, new_k, new_v
+
+
+@functools.partial(
+    jax.jit, static_argnames=("slot", "cfg"), donate_argnums=(1,)
+)
+def _paged_prefill(params: dict, state: PagedState, prompt, slot: int,
+                   cfg: TransformerConfig):
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embedding"][prompt][None].astype(dtype)  # [1, T, D]
+    q_positions = jnp.arange(prompt.shape[0])[None]
+    logits, new_k, new_v = _run_paged(
+        cfg, params, state, x, q_positions, slot
+    )
+    return logits[0], dataclasses.replace(state, pool_k=new_k, pool_v=new_v)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _paged_decode_step(params: dict, state: PagedState, tokens,
+                       cfg: TransformerConfig):
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embedding"][tokens][:, None].astype(dtype)  # [B, 1, D]
+    q_positions = state.lengths[:, None]  # [B, 1]
+    logits, new_k, new_v = _run_paged(cfg, params, state, x, q_positions)
+    active = (state.lengths > 0)
+    return logits, dataclasses.replace(
+        state,
+        pool_k=new_k,
+        pool_v=new_v,
+        lengths=state.lengths + active.astype(jnp.int32),
+    )

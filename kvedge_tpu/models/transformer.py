@@ -29,6 +29,10 @@ class TransformerConfig:
     vocab: int = 32000
     d_model: int = 512
     n_heads: int = 8
+    # Grouped-query attention: number of K/V heads. 0 means n_heads (MHA).
+    # Fewer KV heads shrink the decode-time KV cache by n_heads/n_kv_heads —
+    # the HBM-bandwidth lever for inference serving (models/decode.py).
+    n_kv_heads: int = 0
     n_layers: int = 8
     d_ff: int = 2048
     max_seq: int = 1024
@@ -50,9 +54,15 @@ class TransformerConfig:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
     def validate(self) -> None:
         if self.d_model % self.n_heads:
             raise ValueError("d_model must be divisible by n_heads")
+        if self.n_kv_heads and self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads must be divisible by n_kv_heads")
         if self.attention not in ("naive", "flash", "ring"):
             raise ValueError(
                 "attention must be 'naive', 'flash', or 'ring', "
@@ -64,8 +74,9 @@ def init_params(key, cfg: TransformerConfig) -> dict:
     """Initialize the flat, layer-stacked param tree (fp32)."""
     cfg.validate()
     k_embed, k_qkv, k_out, k_up, k_down = jax.random.split(key, 5)
-    d, h, dh, f, layers = (
-        cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff, cfg.n_layers,
+    d, h, kv, dh, f, layers = (
+        cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.d_head, cfg.d_ff,
+        cfg.n_layers,
     )
 
     def normal(k, shape, scale):
@@ -73,7 +84,9 @@ def init_params(key, cfg: TransformerConfig) -> dict:
 
     return {
         "embedding": normal(k_embed, (cfg.vocab, d), 0.02),
-        "w_qkv": normal(k_qkv, (layers, d, 3 * h * dh), d ** -0.5),
+        # Fused projection: [q | k | v] along the output dim; k/v carry
+        # cfg.kv_heads heads (== n_heads unless GQA is on).
+        "w_qkv": normal(k_qkv, (layers, d, (h + 2 * kv) * dh), d ** -0.5),
         "w_out": normal(k_out, (layers, h * dh, d), (h * dh) ** -0.5),
         "w_up": normal(k_up, (layers, d, f), d ** -0.5),
         "w_down": normal(k_down, (layers, f, d), f ** -0.5),
@@ -110,23 +123,36 @@ def _rotary(x, positions):
     )
 
 
+def split_qkv(cfg: TransformerConfig, qkv):
+    """Split a fused [..., (H+2K)*Dh] projection into q/k/v head tensors."""
+    *lead, _ = qkv.shape
+    h, kv, dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
+    q = qkv[..., : h * dh].reshape(*lead, h, dh)
+    k = qkv[..., h * dh : (h + kv) * dh].reshape(*lead, kv, dh)
+    v = qkv[..., (h + kv) * dh :].reshape(*lead, kv, dh)
+    return q, k, v
+
+
 def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
     """One pre-norm decoder block. x: [B, T, D] in compute dtype."""
     w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
     batch, seq, d = x.shape
-    h, dh = cfg.n_heads, cfg.d_head
+    h, kv, dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
     dtype = x.dtype
 
     # Attention.
     normed = _rmsnorm(x, ln_attn)
-    qkv = normed @ w_qkv.astype(dtype)  # [B, T, 3*H*Dh]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(batch, seq, h, dh)
-    k = k.reshape(batch, seq, h, dh)
-    v = v.reshape(batch, seq, h, dh)
+    qkv = normed @ w_qkv.astype(dtype)  # [B, T, (H+2K)*Dh]
+    q, k, v = split_qkv(cfg, qkv)
     positions = jnp.arange(seq)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
+    if kv != h:
+        # GQA at train time: broadcast each KV head over its query group.
+        # XLA fuses the broadcast into the batched matmuls — no repeated
+        # K/V is materialized in HBM.
+        k = jnp.repeat(k, h // kv, axis=2)
+        v = jnp.repeat(v, h // kv, axis=2)
     if cfg.attention == "ring":
         from kvedge_tpu.parallel.ringattention import ring_attention
 

@@ -24,7 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # never sharded.
 PARAM_RULES: dict[str, P] = {
     "embedding": P(None, "model"),        # [V, D] — feature-sharded
-    "w_qkv": P(None, None, "model"),      # [L, D, 3*H*Dh] — column-parallel
+    "w_qkv": P(None, None, "model"),      # [L, D, (H+2K)*Dh] — column-parallel
     "w_out": P(None, "model", None),      # [L, H*Dh, D] — row-parallel
     "w_up": P(None, None, "model"),       # [L, D, F] — column-parallel
     "w_down": P(None, "model", None),     # [L, F, D] — row-parallel

@@ -226,6 +226,19 @@ def runtime_deployment(values: ChartValues) -> dict:
                                 {"containerPort": SSH_PORT, "name": "ssh"},
                                 {"containerPort": port, "name": "status"},
                             ],
+                            # Single-host topology, re-stated to the
+                            # runtime so boot refuses a TOML declaring
+                            # [distributed] num_processes > 1 (the lone
+                            # pod would otherwise block forever in
+                            # jax.distributed.initialize waiting for
+                            # peers). The StatefulSet variant overwrites
+                            # this with its replica count.
+                            "env": [
+                                {
+                                    "name": "KVEDGE_EXPECTED_PROCESSES",
+                                    "value": "1",
+                                },
+                            ],
                             "resources": {
                                 "requests": {
                                     "cpu": POD_CPU,

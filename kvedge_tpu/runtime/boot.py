@@ -118,6 +118,10 @@ def _run_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             from kvedge_tpu.runtime.workload import run_transformer_probe
 
             return run_transformer_probe(cfg)
+        if cfg.payload == "inference-probe":
+            from kvedge_tpu.runtime.workload import run_inference_probe
+
+            return run_inference_probe(cfg)
         return run_device_check(cfg)
     except Exception as e:
         return _degraded(f"payload {cfg.payload!r} failed: {e!r}")
@@ -136,17 +140,18 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
     started_at = time.time()
     boot_count = heartbeat.next_boot_count(cfg.state_dir)
 
-    check = _booting()
-    dist = DistributedState(active=False)
     handle: RuntimeHandle = None  # assigned below; closures capture it
 
+    # Every consumer (heartbeat, /healthz, /status) reads handle.check —
+    # one source of truth, so a later update (e.g. a re-probe) cannot
+    # leave the endpoints disagreeing about health.
     def build_heartbeat() -> dict:
         return {
             "name": cfg.name,
-            "ok": check.ok,
+            "ok": handle.check.ok,
             "payload": cfg.payload,
             "boot_count": boot_count,
-            "check": check.to_dict(),
+            "check": handle.check.to_dict(),
         }
 
     writer = heartbeat.HeartbeatWriter(
@@ -155,11 +160,12 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
     server = StatusServer(
         cfg.status_bind, cfg.status_port,
         snapshot=lambda: handle.snapshot(),
-        healthy=lambda: check.ok,
+        healthy=lambda: handle.check.ok,
     )
     handle = RuntimeHandle(
-        cfg=cfg, check=check, writer=writer, server=server,
-        boot_count=boot_count, started_at=started_at, distributed=dist,
+        cfg=cfg, check=_booting(), writer=writer, server=server,
+        boot_count=boot_count, started_at=started_at,
+        distributed=DistributedState(active=False),
     )
     writer.beat_once()  # heartbeat visible before the server answers
     server.start()
@@ -169,19 +175,17 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
     # (status stays queryable) instead of crash-looping it.
     topo_error = _topology_mismatch(cfg)
     if topo_error:
-        check = _degraded(topo_error)
+        handle.check = _degraded(topo_error)
     else:
         try:
-            dist = maybe_initialize(cfg.distributed)
+            handle.distributed = maybe_initialize(cfg.distributed)
         except Exception as e:
-            check = _degraded(
+            handle.check = _degraded(
                 f"multi-host join failed "
                 f"(num_processes={cfg.distributed.num_processes}): {e!r}"
             )
         else:
-            check = _run_payload(cfg)
-    handle.check = check
-    handle.distributed = dist
+            handle.check = _run_payload(cfg)
     writer.beat_once()  # refresh: the booting heartbeat is now stale
     return handle
 

@@ -1,10 +1,17 @@
-"""The transformer-probe payload: prove real sharded training works.
+"""Workload payloads: prove real sharded training / serving works.
 
-A step up from the matmul device check: build the flagship transformer on
-the configured mesh, run one jitted, dp×tp-sharded train step, and verify
-the loss is finite and near log(vocab) for random data. This is the
-strongest "the provisioned runtime actually works" signal the status
-endpoint can report.
+A step up from the matmul device check:
+
+* ``transformer-probe`` builds the flagship transformer on the configured
+  mesh, runs one jitted, dp×tp-sharded train step, and verifies the loss
+  is finite and near log(vocab) for random data.
+* ``inference-probe`` exercises the serving path instead: GQA prefill +
+  KV-cache greedy decode (models/decode.py) cross-checked token-for-token
+  against the cache-less forward pass — broken cache plumbing cannot agree
+  with teacher forcing.
+
+These are the strongest "the provisioned runtime actually works" signals
+the status endpoint can report.
 """
 
 from __future__ import annotations
@@ -88,4 +95,69 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
         )
     return dataclasses.replace(
         base, probe_ms=elapsed_ms, probe_checksum=loss,
+    )
+
+
+# Inference probe: small GQA model, short prompt, a few greedy steps.
+PROBE_KV_HEADS = 2
+PROBE_PROMPT = 8
+PROBE_NEW_TOKENS = 4
+
+
+def run_inference_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
+    """Prove the serving path: cached greedy decode == teacher forcing."""
+    base = run_device_check(cfg)
+    if not base.ok:
+        return base
+
+    import dataclasses
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from kvedge_tpu.models import (
+        TransformerConfig, forward, generate, init_params,
+    )
+
+    tcfg = TransformerConfig(
+        vocab=PROBE_VOCAB,
+        d_model=PROBE_D_MODEL,
+        n_heads=4,
+        n_kv_heads=PROBE_KV_HEADS,
+        n_layers=PROBE_LAYERS,
+        d_ff=4 * PROBE_D_MODEL,
+        max_seq=PROBE_SEQ,
+    )
+    try:
+        params = init_params(jax.random.PRNGKey(0), tcfg)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (2, PROBE_PROMPT), 0, tcfg.vocab,
+            dtype=jnp.int32,
+        )
+        start = time.perf_counter()
+        out = generate(params, prompt, tcfg, n_new=PROBE_NEW_TOKENS)
+        out.block_until_ready()
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+
+        # Cross-check every generated token against the cache-less forward
+        # pass — the decode path must reproduce training-time math exactly.
+        so_far = prompt
+        for _ in range(PROBE_NEW_TOKENS):
+            nxt = jnp.argmax(forward(params, so_far, tcfg)[:, -1], axis=-1)
+            so_far = jnp.concatenate(
+                [so_far, nxt[:, None].astype(jnp.int32)], axis=1
+            )
+        if not bool(jnp.all(out == so_far)):
+            return dataclasses.replace(
+                base, ok=False,
+                error="inference probe: cached decode disagrees with "
+                      "teacher-forced forward pass",
+            )
+    except Exception as e:
+        return dataclasses.replace(
+            base, ok=False, error=f"inference probe failed: {e!r}",
+        )
+    return dataclasses.replace(
+        base, probe_ms=elapsed_ms, probe_checksum=float(out.sum()),
     )

@@ -260,3 +260,15 @@ def test_multihost_pods_receive_expected_processes_env():
     env = {e["name"]: e["value"]
            for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]}
     assert env["KVEDGE_EXPECTED_PROCESSES"] == "4"
+
+
+def test_singlehost_pod_receives_expected_processes_env():
+    """The single-host Deployment states its topology too: without it, a
+    helm install of a multi-process TOML with the default tpuNumHosts=1
+    would pass both enforcement paths and the lone pod would block forever
+    in jax.distributed.initialize waiting for peers."""
+    chart = render_all(DEFAULT_VALUES)
+    dep = chart.manifests["jax-tpu-runtime.yaml"]
+    env = {e["name"]: e["value"]
+           for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["KVEDGE_EXPECTED_PROCESSES"] == "1"

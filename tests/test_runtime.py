@@ -173,6 +173,27 @@ def test_transformer_probe_propagates_devicecheck_failure(tmp_path):
     assert "expected platform" in result.error
 
 
+def test_inference_probe_payload(tmp_path):
+    import math
+
+    from kvedge_tpu.runtime.workload import run_inference_probe
+
+    result = run_inference_probe(_cfg(tmp_path, payload="inference-probe"))
+    assert result.ok, result.error
+    assert result.probe_ms > 0
+    # probe_checksum carries the generated-token sum (an int-valued float).
+    assert math.isfinite(result.probe_checksum)
+    assert result.probe_checksum == int(result.probe_checksum)
+
+
+def test_inference_probe_propagates_devicecheck_failure(tmp_path):
+    from kvedge_tpu.runtime.workload import run_inference_probe
+
+    result = run_inference_probe(_cfg(tmp_path, expected_platform="tpu"))
+    assert not result.ok
+    assert "expected platform" in result.error
+
+
 def test_metrics_endpoint(tmp_path):
     import urllib.request
 
