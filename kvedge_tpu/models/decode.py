@@ -178,5 +178,10 @@ def generate(params: dict, prompt, cfg: TransformerConfig, n_new: int):
         logits, cache = decode_step(params, cache, token, cfg)
         return (cache, logits), token
 
-    (_, _), tokens = lax.scan(step, (cache, logits), None, length=n_new)
-    return jnp.concatenate([prompt, tokens.T], axis=1)
+    # n_new - 1 cached steps; the final token falls out of the last carried
+    # logits without paying for a decode step whose logits nobody reads.
+    (_, logits), tokens = lax.scan(
+        step, (cache, logits), None, length=n_new - 1
+    )
+    last = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.concatenate([prompt, tokens.T, last[:, None]], axis=1)

@@ -121,21 +121,26 @@ class PagedKVCache:
         self._host_lengths[slot] = prompt_len
         self._sync()
 
-    def grow(self, slot: int) -> None:
-        """Ensure the slot can hold one more token (allocating if at a
-        page boundary). Called by :meth:`step` — not usually directly."""
+    def grow(self, slot: int) -> bool:
+        """Ensure the slot can hold one more token, allocating a page at a
+        page boundary. Returns True iff a page was allocated — the caller
+        (:meth:`step`) must :meth:`_sync` before the next device step when
+        any table changed; stale device tables would scatter the new token
+        into another sequence's page."""
         if slot not in self._pages_of:
             raise PagedCacheError(f"slot {slot} is not admitted")
         length = self._host_lengths[slot]
         pages = self._pages_of[slot]
-        if length + 1 > len(pages) * self.page_size:
-            if len(pages) == self.max_pages_per_seq:
-                raise PagedCacheError(f"slot {slot} hit max_pages_per_seq")
-            if not self._free:
-                raise PagedCacheError("pool exhausted mid-decode")
-            page = self._free.pop()
-            pages.append(page)
-            self._host_tables[slot][len(pages) - 1] = page
+        if length + 1 <= len(pages) * self.page_size:
+            return False
+        if len(pages) == self.max_pages_per_seq:
+            raise PagedCacheError(f"slot {slot} hit max_pages_per_seq")
+        if not self._free:
+            raise PagedCacheError("pool exhausted mid-decode")
+        page = self._free.pop()
+        pages.append(page)
+        self._host_tables[slot][len(pages) - 1] = page
+        return True
 
     def release(self, slot: int) -> None:
         """Finish a sequence: return its pages to the pool."""
@@ -182,9 +187,13 @@ class PagedKVCache:
         logits [slots, V].
         """
         active = [s for s in self._pages_of]
+        grew = False
         for slot in active:
-            self.grow(slot)
-        self._sync()
+            grew |= self.grow(slot)
+        if grew:
+            # Device tables are stale only when a page was allocated; the
+            # steady-state token step pays no host->device re-upload.
+            self._sync()
         logits, self.state = _paged_decode_step(
             params, self.state, tokens, self.cfg
         )
@@ -306,11 +315,11 @@ def _run_paged(cfg, params, state, x, q_positions, slot=None):
     return logits, new_k, new_v
 
 
-@functools.partial(
-    jax.jit, static_argnames=("slot", "cfg"), donate_argnums=(1,)
-)
-def _paged_prefill(params: dict, state: PagedState, prompt, slot: int,
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _paged_prefill(params: dict, state: PagedState, prompt, slot,
                    cfg: TransformerConfig):
+    # ``slot`` is traced (it is only ever an index), so XLA compiles one
+    # program per prompt length, not one per (slot, length) pair.
     dtype = jnp.dtype(cfg.dtype)
     x = params["embedding"][prompt][None].astype(dtype)  # [1, T, D]
     q_positions = jnp.arange(prompt.shape[0])[None]

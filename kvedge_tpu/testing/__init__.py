@@ -10,5 +10,16 @@ tests observe genuine state survival, not a mock of it.
 """
 
 from kvedge_tpu.testing.fakecluster import FakeCluster, FakeNode
+from kvedge_tpu.testing.faults import (
+    FaultSchedule,
+    FaultScheduleResult,
+    InvariantViolation,
+)
 
-__all__ = ["FakeCluster", "FakeNode"]
+__all__ = [
+    "FakeCluster",
+    "FakeNode",
+    "FaultSchedule",
+    "FaultScheduleResult",
+    "InvariantViolation",
+]
