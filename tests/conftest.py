@@ -1,23 +1,11 @@
 """Test env: force JAX onto a virtual 8-device CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; sharding behavior is tested
-on 8 virtual CPU devices per the build environment contract.
-
-Note: this environment preloads jax via a sitecustomize hook with
-JAX_PLATFORMS pointed at the real TPU tunnel, so setting the env var here is
-too late — the override must go through jax.config before any backend is
-initialized.
+on 8 virtual CPU devices per the build environment contract. See
+``kvedge_tpu/testing/jaxenv.py`` for why the ordering (env vars *and*
+jax.config, before any backend init) is load-bearing.
 """
 
-import os
+from kvedge_tpu.testing.jaxenv import force_virtual_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu_devices(8)

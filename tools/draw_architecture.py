@@ -1,0 +1,148 @@
+"""Generate images/Architecture.png — the architecture diagram.
+
+The reference embeds a diagram of its deployment shape (reference
+``images/Architecture.png`` at ``README.md:15``: virt-launcher pod,
+DataVolume disk, VMI with IoT Edge runtime, LB service, external SSH
+client, nested-virt node pool; SURVEY.md §2 #15). This script draws the
+kvedge-tpu equivalent so the artifact is reproducible from source.
+
+Usage: python tools/draw_architecture.py
+"""
+
+from __future__ import annotations
+
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+from matplotlib.patches import FancyArrowPatch, FancyBboxPatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "images", "Architecture.png")
+
+INK = "#1f2430"
+EDGE = "#5b6472"
+FILL_CLUSTER = "#eef1f5"
+FILL_NODE = "#e1e7ee"
+FILL_POD = "#ffffff"
+FILL_STATE = "#fdf3dd"
+FILL_SECRET = "#e8f0e4"
+FILL_SVC = "#e4ecf7"
+ACCENT = "#3461ab"
+
+
+def box(ax, x, y, w, h, label, fill, *, fontsize=10, bold=False,
+        align_top=False, pad=0.02):
+    ax.add_patch(FancyBboxPatch(
+        (x, y), w, h, boxstyle="round,pad=0.012,rounding_size=0.015",
+        linewidth=1.1, edgecolor=EDGE, facecolor=fill, zorder=2))
+    if align_top:
+        ax.text(x + w / 2, y + h - pad, label, ha="center", va="top",
+                fontsize=fontsize, color=INK, zorder=3,
+                fontweight="bold" if bold else "normal")
+    else:
+        ax.text(x + w / 2, y + h / 2, label, ha="center", va="center",
+                fontsize=fontsize, color=INK, zorder=3,
+                fontweight="bold" if bold else "normal")
+
+
+def arrow(ax, xy_from, xy_to, label=None, *, color=ACCENT, lx=0.0, ly=0.012,
+          ha="center"):
+    ax.add_patch(FancyArrowPatch(
+        xy_from, xy_to, arrowstyle="-|>", mutation_scale=14,
+        linewidth=1.4, color=color, zorder=4))
+    if label:
+        mx = (xy_from[0] + xy_to[0]) / 2 + lx
+        my = (xy_from[1] + xy_to[1]) / 2 + ly
+        ax.text(mx, my, label, ha=ha, va="bottom", fontsize=8.5,
+                color=color, zorder=4)
+
+
+def main() -> int:
+    fig, ax = plt.subplots(figsize=(12.8, 7.2), dpi=150)
+    ax.set_xlim(0, 1)
+    ax.set_ylim(0, 1)
+    ax.axis("off")
+
+    # Cluster envelope.
+    box(ax, 0.215, 0.04, 0.765, 0.92,
+        "Kubernetes cluster (GKE)", FILL_CLUSTER, fontsize=12, bold=True,
+        align_top=True)
+
+    # LoadBalancer service (inside cluster, outside the node pool —
+    # between the external client and the pod, as in the reference).
+    box(ax, 0.235, 0.33, 0.16, 0.13,
+        "LoadBalancer Service\n(conditional)\nSSH :22 · status :8476",
+        FILL_SVC, fontsize=8.8)
+
+    # TPU node pool.
+    box(ax, 0.415, 0.08, 0.545, 0.80,
+        "TPU node pool\n(cloud.google.com/gke-tpu-accelerator: "
+        "tpu-v5-lite-podslice)", FILL_NODE, fontsize=10, align_top=True)
+
+    # Runtime pod.
+    box(ax, 0.435, 0.12, 0.285, 0.60, "", FILL_POD)
+    ax.text(0.5775, 0.695, "runtime pod\n(Recreate Deployment; StatefulSet\n"
+            "per host on multi-host slices)",
+            ha="center", va="top", fontsize=9.2, color=INK,
+            fontweight="bold")
+    box(ax, 0.45, 0.475, 0.255, 0.115,
+        "bootstrap entrypoint\n#kvedge-boot-config: bootcmd → runcmd\n"
+        "(find config disk by serial, apply)", FILL_POD, fontsize=8.2)
+    box(ax, 0.45, 0.32, 0.255, 0.13,
+        "JAX TPU runtime\njax.distributed + Mesh(dp×tp / dp×sp)\n"
+        "device check · heartbeat · status :8476", FILL_POD, fontsize=8.2)
+    box(ax, 0.45, 0.155, 0.255, 0.14,
+        "payload\ntransformer-probe / inference-probe\n"
+        "(pjit over the mesh, Pallas flash attn)", FILL_POD, fontsize=8.2)
+
+    # Right column: secrets, state PVC, chips.
+    box(ax, 0.755, 0.60, 0.185, 0.115,
+        "Secret: runtime config\n(config.toml →\nserial-tagged volume)",
+        FILL_SECRET, fontsize=8.2)
+    box(ax, 0.755, 0.465, 0.185, 0.10,
+        "Secret: boot config\n(#kvedge-boot-config)", FILL_SECRET,
+        fontsize=8.2)
+    box(ax, 0.755, 0.30, 0.185, 0.13,
+        "state PVC\nheartbeats · boot_count\norbax checkpoints", FILL_STATE,
+        fontsize=8.2)
+    box(ax, 0.755, 0.14, 0.185, 0.12,
+        "TPU chips\n(google.com/tpu)\nMXU · HBM · ICI", FILL_NODE,
+        fontsize=8.2)
+
+    # External actors.
+    box(ax, 0.02, 0.60, 0.155, 0.15,
+        "operator\nhelm install /\npython -m kvedge_tpu render", FILL_POD,
+        fontsize=8.6)
+    box(ax, 0.02, 0.345, 0.155, 0.10, "external client\nssh / curl",
+        FILL_POD, fontsize=8.6)
+
+    # Arrows.
+    arrow(ax, (0.175, 0.675), (0.435, 0.64), "manifests", ly=0.02)
+    arrow(ax, (0.175, 0.395), (0.235, 0.395), "public IP", ly=0.018)
+    arrow(ax, (0.395, 0.395), (0.45, 0.395), "selector", ly=-0.042)
+    arrow(ax, (0.755, 0.655), (0.705, 0.565), "mounted\nby serial",
+          lx=-0.026, ly=0.028, ha="right")
+    arrow(ax, (0.755, 0.51), (0.705, 0.525), "boot doc", lx=-0.004,
+          ly=-0.048)
+    arrow(ax, (0.705, 0.375), (0.755, 0.37), "state\nwrite-through",
+          lx=-0.002, ly=0.022)
+    arrow(ax, (0.705, 0.20), (0.755, 0.195), "XLA / libtpu", lx=-0.012,
+          ly=-0.052)
+
+    ax.text(0.5, 0.005,
+            "kvedge-tpu: JAX TPU runtime provisioning on Kubernetes — "
+            "pod-native re-design of the reference's KubeVirt VM shape "
+            "(SURVEY.md §7)",
+            ha="center", va="bottom", fontsize=9, color=EDGE)
+
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    fig.savefig(OUT, bbox_inches="tight", facecolor="white")
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

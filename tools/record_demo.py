@@ -1,0 +1,143 @@
+"""Record the end-to-end deployment demo as an asciinema v2 cast.
+
+The reference repo's only end-to-end demonstration artifact is a terminal
+recording of a human typing the README's deployment steps (reference
+``deployment/az-iot-edge-k8s-kubevirt-ascii.cast``, asciinema v2, linked at
+``README.md:63``; SURVEY.md §2 #14). This script produces the analogue for
+kvedge-tpu: it *actually runs* the README's commands — the CLI renderer and
+the fake-cluster resilience demo (``tools/demo_cluster.py``) — captures
+their real output, and writes an asciinema v2 file with synthesized
+keystroke timing.
+
+Usage: python tools/record_demo.py [output.cast]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_OUT = os.path.join(REPO, "deployment",
+                           "jax-tpu-k8s-demo-ascii.cast")
+
+CONFIG_TOML = """\
+[runtime]
+name = "edge-tpu-demo"
+heartbeat_interval_s = 10.0
+
+[tpu]
+platform = "cpu"          # demo runs on a virtual 8-device CPU mesh
+expected_chips = 8
+
+[mesh]
+axes = { data = 0, model = 4 }
+
+[payload]
+kind = "devicecheck"
+"""
+
+SSH_KEY = "ssh-ed25519 AAAAC3NzaDemoKeyForTheRecordingOnly op@laptop"
+
+
+class Cast:
+    """Accumulates asciinema v2 events with deterministic pseudo-timing."""
+
+    def __init__(self) -> None:
+        self.t = 0.5
+        self.events: list[tuple[float, str, str]] = []
+        self.rng = random.Random(20260729)
+
+    def out(self, data: str, *, dt: float = 0.0) -> None:
+        self.t += dt
+        self.events.append((round(self.t, 6), "o", data))
+
+    def prompt(self) -> None:
+        self.out("\x1b[1;32mop@laptop\x1b[0m:\x1b[1;34m~/kvedge-tpu\x1b[0m$ ",
+                 dt=0.35)
+
+    def type_command(self, text: str) -> None:
+        for ch in text:
+            self.out(ch, dt=self.rng.uniform(0.02, 0.09))
+        self.out("\r\n", dt=0.25)
+
+    def command_output(self, text: str) -> None:
+        for line in text.splitlines():
+            self.out(line + "\r\n", dt=self.rng.uniform(0.004, 0.03))
+
+    def write(self, path: str) -> None:
+        header = {
+            "version": 2,
+            "width": 100,
+            "height": 30,
+            "timestamp": int(time.time()),
+            "title": "kvedge-tpu-e2e",
+            "env": {"SHELL": "/bin/bash", "TERM": "xterm-256color"},
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps(header) + "\n")
+            for ev in self.events:
+                fh.write(json.dumps(list(ev)) + "\n")
+
+
+def run(cmd: list[str], cwd: str) -> str:
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, cwd=cwd, env=env, text=True,
+                          capture_output=True)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise RuntimeError(f"{cmd} failed with exit {proc.returncode}")
+    return proc.stdout
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_OUT
+    cast = Cast()
+    workdir = tempfile.mkdtemp(prefix="kvedge-demo-")
+    with open(os.path.join(workdir, "config.toml"), "w",
+              encoding="utf-8") as fh:
+        fh.write(CONFIG_TOML)
+
+    python = sys.executable
+    steps: list[tuple[str, list[str]]] = [
+        ("python -m kvedge_tpu version",
+         [python, "-m", "kvedge_tpu", "version"]),
+        ("cat config.toml",
+         ["cat", "config.toml"]),
+        ("python -m kvedge_tpu render "
+         f"--set publicSshKey=\"{SSH_KEY}\" "
+         "--set-file jaxRuntimeConfig=config.toml --output-dir manifests",
+         [python, "-m", "kvedge_tpu", "render",
+          "--set", f"publicSshKey={SSH_KEY}",
+          "--set-file", "jaxRuntimeConfig=config.toml",
+          "--output-dir", "manifests"]),
+        ("ls manifests",
+         ["ls", "manifests"]),
+        ("python tools/demo_cluster.py manifests  "
+         "# fake-cluster deploy + node-failure drill",
+         [python, os.path.join(REPO, "tools", "demo_cluster.py"),
+          "manifests"]),
+        ("python -m kvedge_tpu notes",
+         [python, "-m", "kvedge_tpu", "notes"]),
+    ]
+
+    for shown, cmd in steps:
+        cast.prompt()
+        cast.type_command(shown)
+        cast.command_output(run(cmd, workdir))
+    cast.prompt()
+    cast.out("\r\n", dt=1.2)
+
+    cast.write(out_path)
+    print(f"wrote {out_path} ({len(cast.events)} events, "
+          f"{cast.t:.1f}s duration)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
