@@ -11,12 +11,14 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 
 from kvedge_tpu.config.runtime_config import RuntimeConfig
 from kvedge_tpu.parallel.distributed import DistributedState, maybe_initialize
 from kvedge_tpu.runtime import heartbeat
 from kvedge_tpu.runtime.devicecheck import DeviceCheckResult, run_device_check
+from kvedge_tpu.runtime.profiling import CaptureUnavailable, TraceCapture
 from kvedge_tpu.runtime.status import StatusServer
 
 
@@ -157,10 +159,27 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
     writer = heartbeat.HeartbeatWriter(
         cfg.state_dir, cfg.heartbeat_interval_s, build_heartbeat
     )
+
+    # The profiler must not run before boot completes: a capture touches
+    # the JAX backend, and initializing the backend from the handler
+    # thread would permanently break the multi-host join below
+    # (jax.distributed.initialize must precede any backend init).
+    boot_complete = threading.Event()
+    trace_capture = TraceCapture(cfg.state_dir)
+
+    def profile(seconds: float) -> dict:
+        if not boot_complete.is_set():
+            raise CaptureUnavailable(
+                "runtime is still booting; retry once /status shows the "
+                "payload check"
+            )
+        return trace_capture.capture(seconds)
+
     server = StatusServer(
         cfg.status_bind, cfg.status_port,
         snapshot=lambda: handle.snapshot(),
         healthy=lambda: handle.check.ok,
+        profiler=profile,
     )
     handle = RuntimeHandle(
         cfg=cfg, check=_booting(), writer=writer, server=server,
@@ -186,6 +205,7 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
             )
         else:
             handle.check = _run_payload(cfg)
+    boot_complete.set()  # safe to touch the backend from handler threads now
     writer.beat_once()  # refresh: the booting heartbeat is now stale
     return handle
 

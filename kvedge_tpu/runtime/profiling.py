@@ -1,0 +1,128 @@
+"""On-demand profiler trace capture, persisted through the state volume.
+
+The reference has no tracing or profiling subsystem of any kind
+(SURVEY.md §5, "Tracing / profiling: absent") — this is an addition, in
+the same spirit as the status/metrics endpoints: the runtime's one
+externally reachable surface should also be able to answer "what is the
+device actually doing?". A capture runs ``jax.profiler`` for a bounded
+window and writes the trace (xplane + trace.json.gz, loadable in
+XProf/TensorBoard or Perfetto) under ``<state_dir>/traces/``, so traces
+survive pod rescheduling exactly like heartbeats and checkpoints do.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import Callable
+
+
+class CaptureBusy(RuntimeError):
+    """A trace capture is already in progress (only one at a time)."""
+
+
+class CaptureUnavailable(RuntimeError):
+    """The runtime cannot profile right now (e.g. still booting)."""
+
+
+_jitted_matmul = None
+
+
+def default_activity() -> None:
+    """A small device workload so a capture is never empty.
+
+    The profiler records whatever the devices do during the window; on a
+    runtime whose payload is idle between heartbeats, that could be
+    nothing. One jitted matmul guarantees at least one device program in
+    every trace. The jitted callable is cached at module level — a fresh
+    ``jax.jit(lambda ...)`` per call would retrace every loop iteration
+    and fill the trace with compile events instead of device work.
+    """
+    global _jitted_matmul
+    import jax
+    import jax.numpy as jnp
+
+    if _jitted_matmul is None:
+        _jitted_matmul = jax.jit(lambda a: a @ a)
+    x = jnp.ones((512, 512), jnp.bfloat16)
+    _jitted_matmul(x).block_until_ready()
+
+
+class TraceCapture:
+    """Bounded, serialized ``jax.profiler`` captures into the state dir.
+
+    Trace directories are numbered past any that already exist on the
+    state volume (the volume outlives the pod), and only the newest
+    ``keep`` traces are retained — the traces dir shares its PVC with
+    heartbeats and checkpoints, and the capture endpoint is reachable
+    through the LoadBalancer, so unbounded growth would let repeated
+    captures fill the volume and degrade the runtime.
+    """
+
+    def __init__(self, state_dir: str, *, max_seconds: float = 60.0,
+                 keep: int = 8,
+                 activity: Callable[[], None] | None = default_activity):
+        self._traces_dir = os.path.join(state_dir, "traces")
+        self._max_seconds = max_seconds
+        self._keep = max(1, keep)
+        self._activity = activity
+        self._lock = threading.Lock()
+
+    def _existing_traces(self) -> list[str]:
+        try:
+            names = os.listdir(self._traces_dir)
+        except FileNotFoundError:
+            return []
+        return sorted(n for n in names if n.startswith("trace-"))
+
+    def _next_trace_dir(self) -> str:
+        existing = self._existing_traces()
+        seq = 0
+        for name in existing:
+            try:
+                seq = max(seq, int(name.split("-", 1)[1]))
+            except ValueError:
+                continue
+        return os.path.join(self._traces_dir, f"trace-{seq + 1:04d}")
+
+    def _sweep_retention(self) -> None:
+        for name in self._existing_traces()[:-self._keep]:
+            shutil.rmtree(os.path.join(self._traces_dir, name),
+                          ignore_errors=True)
+
+    def capture(self, seconds: float = 3.0) -> dict:
+        """Trace device activity for ``seconds``; return a summary doc."""
+        seconds = min(max(float(seconds), 0.1), self._max_seconds)
+        if not self._lock.acquire(blocking=False):
+            raise CaptureBusy("a trace capture is already running")
+        try:
+            import jax
+
+            trace_dir = self._next_trace_dir()
+            os.makedirs(trace_dir, exist_ok=True)
+            started = time.time()
+            jax.profiler.start_trace(trace_dir)
+            try:
+                deadline = started + seconds
+                while time.time() < deadline:
+                    if self._activity is not None:
+                        self._activity()
+                    else:
+                        time.sleep(min(0.1, deadline - time.time()))
+            finally:
+                jax.profiler.stop_trace()
+            self._sweep_retention()
+            files = [
+                os.path.join(root, f)
+                for root, _, fs in os.walk(trace_dir) for f in fs
+            ]
+            return {
+                "trace_dir": trace_dir,
+                "duration_s": round(time.time() - started, 3),
+                "files": len(files),
+                "bytes": sum(os.path.getsize(f) for f in files),
+            }
+        finally:
+            self._lock.release()

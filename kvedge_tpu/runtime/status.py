@@ -5,7 +5,9 @@ shows Running, then ssh in (``NOTES.txt:8-12``); it has no observability
 subsystem at all (SURVEY.md §5). kvedge-tpu adds a machine surface behind
 the same LoadBalancer: ``/healthz`` for external monitors, ``/status`` for
 the full runtime picture (devices, mesh, heartbeat age, boot count),
-``/metrics`` in Prometheus text format, ``/version`` for kubelet probes.
+``/metrics`` in Prometheus text format, ``/version`` for kubelet probes,
+and ``POST /profile?seconds=N`` for an on-demand profiler trace capture
+(``kvedge_tpu/runtime/profiling.py``).
 """
 
 from __future__ import annotations
@@ -14,7 +16,9 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
+from urllib.parse import parse_qs, urlsplit
 
+from kvedge_tpu.runtime.profiling import CaptureBusy, CaptureUnavailable
 from kvedge_tpu.version import __version__
 
 _METRIC_FIELDS = (
@@ -61,11 +65,13 @@ class StatusServer:
     """
 
     def __init__(self, bind: str, port: int, snapshot: Callable[[], dict],
-                 healthy: Callable[[], bool] | None = None):
+                 healthy: Callable[[], bool] | None = None,
+                 profiler: Callable[[float], dict] | None = None):
         outer = self
         self._healthy = healthy or (
             lambda: bool(snapshot().get("ok", False))
         )
+        self._profiler = profiler
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet by default
@@ -97,8 +103,36 @@ class StatusServer:
                     self._send(200, outer._snapshot())
                 elif self.path == "/version":
                     self._send(200, {"version": __version__})
+                elif urlsplit(self.path).path == "/profile":
+                    self._send(405, {
+                        "error": "use POST /profile?seconds=N to capture"
+                    })
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                url = urlsplit(self.path)
+                if url.path != "/profile":
+                    self._send(404, {"error": f"no route {url.path}"})
+                    return
+                if outer._profiler is None:
+                    self._send(503, {"error": "profiler not available"})
+                    return
+                try:
+                    seconds = float(
+                        parse_qs(url.query).get("seconds", ["3"])[0]
+                    )
+                except ValueError:
+                    self._send(400, {"error": "seconds must be a number"})
+                    return
+                try:
+                    self._send(200, outer._profiler(seconds))
+                except CaptureBusy as e:
+                    self._send(409, {"error": str(e)})
+                except CaptureUnavailable as e:
+                    self._send(503, {"error": str(e)})
+                except Exception as e:  # capture failed; stay serving
+                    self._send(500, {"error": f"capture failed: {e!r}"})
 
         self._snapshot = snapshot
         self._server = ThreadingHTTPServer((bind, port), Handler)

@@ -178,6 +178,19 @@ class FakeCluster:
             if "persistentVolumeClaim" in v
         ]
 
+    def pod_state_path(self, pod: FakePod, relpath: str) -> str:
+        """Path of a file on the pod's PVC backing dir (mount-path free).
+
+        The persistent backing directory under ``state_root`` is keyed by
+        PVC name, so this resolves the same file across pod generations —
+        the public way to inspect persisted state (heartbeats etc.)
+        without hardcoding the chart's mountPath.
+        """
+        if self.state_root is None:
+            raise FakeClusterError("state_root required for pod_state_path")
+        (pvc,) = self._pod_pvcs(pod)
+        return os.path.join(self.state_root, pvc.name, relpath)
+
     def _schedulable_node(self, pod: FakePod) -> tuple[str | None, str]:
         selector = pod.spec["spec"].get("nodeSelector", {})
         candidates = [

@@ -216,10 +216,7 @@ class FaultSchedule:
 
     def _read_heartbeat(self) -> dict:
         pod = self.cluster.running_pod(self.deployment)
-        (pvc,) = self.cluster._pod_pvcs(pod)
-        path = os.path.join(
-            self.cluster.state_root, pvc.name, "heartbeat.json"
-        )
+        path = self.cluster.pod_state_path(pod, "heartbeat.json")
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 return json.load(fh)

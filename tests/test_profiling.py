@@ -1,0 +1,161 @@
+"""On-demand profiler capture: trace files land in the state volume.
+
+The reference has no tracing/profiling subsystem (SURVEY.md §5); this is
+an added observability surface, so there is no reference behavior to
+mirror — the contract under test is our own: ``POST /profile?seconds=N``
+captures a bounded jax.profiler trace into ``<state_dir>/traces/`` and
+concurrent captures are refused, not queued.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kvedge_tpu.runtime.profiling import (
+    CaptureBusy,
+    CaptureUnavailable,
+    TraceCapture,
+)
+from kvedge_tpu.runtime.status import StatusServer
+
+
+def test_capture_writes_trace_files(tmp_path):
+    cap = TraceCapture(str(tmp_path))
+    doc = cap.capture(seconds=0.2)
+    assert doc["trace_dir"].startswith(str(tmp_path / "traces"))
+    assert doc["files"] > 0 and doc["bytes"] > 0
+    assert doc["duration_s"] >= 0.2
+
+
+def test_captures_are_sequenced_not_overwritten(tmp_path):
+    cap = TraceCapture(str(tmp_path))
+    first = cap.capture(seconds=0.1)
+    second = cap.capture(seconds=0.1)
+    assert first["trace_dir"] != second["trace_dir"]
+
+
+def test_seq_resumes_past_traces_from_a_previous_boot(tmp_path):
+    # The traces dir lives on the PVC and outlives the pod; a fresh
+    # process (new TraceCapture) must number past what's already there,
+    # not overwrite trace-0001.
+    first = TraceCapture(str(tmp_path)).capture(seconds=0.1)
+    second = TraceCapture(str(tmp_path)).capture(seconds=0.1)
+    assert first["trace_dir"].endswith("trace-0001")
+    assert second["trace_dir"].endswith("trace-0002")
+
+
+def test_retention_keeps_only_newest(tmp_path):
+    cap = TraceCapture(str(tmp_path), keep=2)
+    for _ in range(3):
+        cap.capture(seconds=0.1)
+    remaining = sorted((tmp_path / "traces").iterdir())
+    assert [p.name for p in remaining] == ["trace-0002", "trace-0003"]
+
+
+def test_concurrent_capture_is_refused(tmp_path):
+    release = threading.Event()
+
+    def slow_activity():
+        release.wait(timeout=5)
+
+    cap = TraceCapture(str(tmp_path), activity=slow_activity)
+    results = {}
+
+    def long_capture():
+        results["first"] = cap.capture(seconds=0.3)
+
+    t = threading.Thread(target=long_capture)
+    t.start()
+    time.sleep(0.05)  # let the first capture take the lock
+    with pytest.raises(CaptureBusy):
+        cap.capture(seconds=0.1)
+    release.set()
+    t.join()
+    assert results["first"]["files"] >= 0
+
+
+# ---- HTTP route ----------------------------------------------------------
+
+
+def _post(url: str) -> tuple[int, dict]:
+    req = urllib.request.Request(url, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def server(tmp_path):
+    cap = TraceCapture(str(tmp_path))
+    srv = StatusServer(
+        "127.0.0.1", 0, snapshot=lambda: {"ok": True},
+        profiler=cap.capture,
+    )
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_post_profile_returns_trace_summary(server, tmp_path):
+    code, doc = _post(
+        f"http://127.0.0.1:{server.port}/profile?seconds=0.2"
+    )
+    assert code == 200
+    assert doc["files"] > 0
+    assert (tmp_path / "traces").is_dir()
+
+
+def test_get_profile_is_405(server):
+    with urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/status"), timeout=10
+    ) as resp:
+        assert resp.status == 200
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/profile", timeout=10)
+        raised = None
+    except urllib.error.HTTPError as e:
+        raised = e.code
+    assert raised == 405
+
+
+def test_post_profile_bad_seconds_is_400(server):
+    code, doc = _post(
+        f"http://127.0.0.1:{server.port}/profile?seconds=abc"
+    )
+    assert code == 400
+
+
+def test_post_profile_while_booting_is_503(tmp_path):
+    # start_runtime gates the profiler until boot completes (a capture
+    # would initialize the JAX backend and break a multi-host join);
+    # the gate surfaces as CaptureUnavailable -> HTTP 503.
+    def gated(seconds):
+        raise CaptureUnavailable("runtime is still booting")
+
+    srv = StatusServer("127.0.0.1", 0, snapshot=lambda: {"ok": True},
+                       profiler=gated)
+    srv.start()
+    try:
+        code, doc = _post(f"http://127.0.0.1:{srv.port}/profile")
+        assert code == 503
+        assert "booting" in doc["error"]
+    finally:
+        srv.shutdown()
+
+
+def test_post_profile_without_profiler_is_503(tmp_path):
+    srv = StatusServer("127.0.0.1", 0, snapshot=lambda: {"ok": True})
+    srv.start()
+    try:
+        code, doc = _post(f"http://127.0.0.1:{srv.port}/profile")
+        assert code == 503
+    finally:
+        srv.shutdown()

@@ -93,10 +93,7 @@ def main() -> int:
 
 
 def _read_heartbeat(cluster: FakeCluster, pod) -> dict:
-    # Read through the PVC's persistent backing directory (mount-path
-    # independent), the same way the fault harness does.
-    (pvc,) = cluster._pod_pvcs(pod)
-    path = os.path.join(cluster.state_root, pvc.name, "heartbeat.json")
+    path = cluster.pod_state_path(pod, "heartbeat.json")
     with open(path, "r", encoding="utf-8") as fh:
         return json.load(fh)
 
