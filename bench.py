@@ -24,41 +24,61 @@ from kvedge_tpu.models import init_params, make_train_step
 from kvedge_tpu.parallel import build_mesh, shard_batch, shard_params
 
 SEQ = 512
-BATCH_PER_DEVICE = 16  # best measured throughput on v5e-1
+# Best measured throughput on v5e-1 (tools/bench_sweep.py): bf16 readout +
+# fused cross-entropy moved the sweet spot from 16 to 64 per device.
+BATCH_PER_DEVICE = 64
 WARMUP_STEPS = 3
 TIMED_STEPS = 10
 
 
-def main() -> int:
+def measure(cfg, batch_per_device: int, seq: int, steps: int,
+            warmup: int = WARMUP_STEPS):
+    """Measure train-step throughput. Returns (tokens_per_sec, final_loss, n).
+
+    Shared by the headline run below and tools/bench_sweep.py so the two
+    always use identical methodology (same sharding setup, warmup, and
+    sync discipline).
+    """
+    if warmup < 1:
+        # At least one warmup step is required: it absorbs XLA compilation
+        # and provides the loss whose float() forces the pre-timing sync.
+        # Checked before the expensive param-init/sharding setup below.
+        raise ValueError("measure() needs warmup >= 1")
     devices = jax.devices()
     n = len(devices)
     mesh = build_mesh(_factor_mesh(n), devices=devices)
 
-    params = shard_params(mesh, init_params(jax.random.PRNGKey(0), FLAGSHIP))
-    init_opt, train_step = make_train_step(FLAGSHIP)
+    params = shard_params(mesh, init_params(jax.random.PRNGKey(0), cfg))
+    init_opt, train_step = make_train_step(cfg)
     opt_state = init_opt(params)
     batch = shard_batch(
         mesh,
         jax.random.randint(
-            jax.random.PRNGKey(1), (BATCH_PER_DEVICE * n, SEQ + 1), 0,
-            FLAGSHIP.vocab, dtype=jnp.int32,
+            jax.random.PRNGKey(1), (batch_per_device * n, seq + 1), 0,
+            cfg.vocab, dtype=jnp.int32,
         ),
     )
 
-    for _ in range(WARMUP_STEPS):
+    for _ in range(warmup):
         params, opt_state, loss = train_step(params, opt_state, batch)
     # float() forces a device->host transfer — a hard sync even on backends
     # whose block_until_ready returns early (observed on the remote relay).
     float(loss)
 
     start = time.perf_counter()
-    for _ in range(TIMED_STEPS):
+    for _ in range(steps):
         params, opt_state, loss = train_step(params, opt_state, batch)
     final_loss = float(loss)
     elapsed = time.perf_counter() - start
 
-    tokens = BATCH_PER_DEVICE * n * SEQ * TIMED_STEPS
-    tokens_per_sec = tokens / elapsed
+    tokens = batch_per_device * n * seq * steps
+    return tokens / elapsed, final_loss, n
+
+
+def main() -> int:
+    tokens_per_sec, final_loss, n = measure(
+        FLAGSHIP, BATCH_PER_DEVICE, SEQ, TIMED_STEPS
+    )
     print(
         json.dumps(
             {
@@ -70,8 +90,8 @@ def main() -> int:
         )
     )
     print(
-        f"devices={n} platform={devices[0].platform} "
-        f"loss={final_loss:.3f} elapsed={elapsed:.2f}s",
+        f"devices={n} platform={jax.devices()[0].platform} "
+        f"loss={final_loss:.3f}",
         file=sys.stderr,
     )
     return 0

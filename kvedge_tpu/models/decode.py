@@ -36,6 +36,7 @@ from kvedge_tpu.models.transformer import (
     _rmsnorm,
     _rotary,
     split_qkv,
+    tied_readout,
 )
 
 
@@ -134,7 +135,7 @@ def _run_layers(cfg: TransformerConfig, params: dict, x, cache: KVCache, pos):
 
     x, (new_k, new_v) = lax.scan(body, x, (_stacked(params), cache.k, cache.v))
     x = _rmsnorm(x, params["ln_final"])
-    logits = x[:, -1].astype(jnp.float32) @ params["embedding"].T
+    logits = tied_readout(x[:, -1], params["embedding"])
     new_cache = KVCache(k=new_k, v=new_v, length=pos + x.shape[1])
     return logits, new_cache
 

@@ -35,6 +35,7 @@ from kvedge_tpu.models.transformer import (
     _rmsnorm,
     _rotary,
     split_qkv,
+    tied_readout,
 )
 from kvedge_tpu.models.decode import _stacked
 
@@ -311,7 +312,7 @@ def _run_paged(cfg, params, state, x, q_positions, slot=None):
         body, x, (_stacked(params), state.pool_k, state.pool_v)
     )
     x = _rmsnorm(x, params["ln_final"])
-    logits = x[:, -1].astype(jnp.float32) @ params["embedding"].T
+    logits = tied_readout(x[:, -1], params["embedding"])
     return logits, new_k, new_v
 
 

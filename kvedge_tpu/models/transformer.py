@@ -96,6 +96,23 @@ def init_params(key, cfg: TransformerConfig) -> dict:
     }
 
 
+def tied_readout(x, embedding):
+    """Weight-tied logits readout: bf16 operands with fp32 accumulation.
+
+    The MXU multiplies in bf16 and accumulates in fp32 natively, so this
+    keeps the largest matmul in the model (D x vocab — roughly half its
+    FLOPs) at full MXU rate while logits still come out fp32 for a stable
+    softmax; a plain fp32 x fp32 matmul here runs at a fraction of the
+    bf16 rate. Shared by forward(), contiguous decode, and paged decode:
+    the inference probe (runtime/workload.py) asserts those paths agree
+    token for token, so they must round identically — one helper makes
+    that invariant structural.
+    """
+    return jnp.dot(
+        x, embedding.T.astype(x.dtype), preferred_element_type=jnp.float32
+    )
+
+
 def _rmsnorm(x, gain):
     scale = jax.lax.rsqrt(
         jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
@@ -235,8 +252,7 @@ def forward(params: dict, tokens, cfg: TransformerConfig, mesh=None):
         body = jax.checkpoint(body)
     x, _ = lax.scan(body, x, stacked)
     x = _rmsnorm(x, params["ln_final"])
-    # Weight-tied readout in fp32 for a stable softmax.
-    return x.astype(jnp.float32) @ embedding.T
+    return tied_readout(x, embedding)
 
 
 def loss_fn(params: dict, batch, cfg: TransformerConfig, mesh=None):
@@ -244,11 +260,14 @@ def loss_fn(params: dict, batch, cfg: TransformerConfig, mesh=None):
     inputs = batch[:, :-1]
     targets = batch[:, 1:]
     logits = forward(params, inputs, cfg, mesh)
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    token_ll = jnp.take_along_axis(
-        logprobs, targets[..., None], axis=-1
+    # Fused cross-entropy: logsumexp(logits) - logits[target] needs only
+    # two [B, T] reductions over the vocab axis, instead of materializing a
+    # second [B, T, V] fp32 log-probs tensor (which at vocab=32000 would be
+    # the largest buffer in the step).
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
     )[..., 0]
-    return -jnp.mean(token_ll)
+    return jnp.mean(jax.nn.logsumexp(logits, axis=-1) - target_logit)
 
 
 def make_train_step(cfg: TransformerConfig, optimizer=None, mesh=None):

@@ -70,22 +70,33 @@ class TraceCapture:
         self._activity = activity
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _trace_seq(name: str) -> int:
+        try:
+            return int(name.split("-", 1)[1])
+        except (IndexError, ValueError):
+            return -1
+
     def _existing_traces(self) -> list[str]:
+        """Trace dir names, oldest first by *numeric* sequence.
+
+        Lexicographic order would break past trace-9999 (``trace-10000``
+        sorts before ``trace-1001``), making retention delete the capture
+        it just wrote.
+        """
         try:
             names = os.listdir(self._traces_dir)
         except FileNotFoundError:
             return []
-        return sorted(n for n in names if n.startswith("trace-"))
+        return sorted(
+            (n for n in names if n.startswith("trace-")), key=self._trace_seq
+        )
 
     def _next_trace_dir(self) -> str:
-        existing = self._existing_traces()
-        seq = 0
-        for name in existing:
-            try:
-                seq = max(seq, int(name.split("-", 1)[1]))
-            except ValueError:
-                continue
-        return os.path.join(self._traces_dir, f"trace-{seq + 1:04d}")
+        seq = max(
+            (self._trace_seq(n) for n in self._existing_traces()), default=0
+        )
+        return os.path.join(self._traces_dir, f"trace-{max(seq, 0) + 1:04d}")
 
     def _sweep_retention(self) -> None:
         for name in self._existing_traces()[:-self._keep]:
@@ -105,12 +116,25 @@ class TraceCapture:
             started = time.time()
             jax.profiler.start_trace(trace_dir)
             try:
+                # The activity only needs to guarantee the trace is never
+                # empty — run it at a slow cadence and sleep the rest of
+                # the window, so a long capture records the *payload's*
+                # device work instead of drowning it in synthetic matmuls
+                # (and doesn't peg a host thread for the whole window).
                 deadline = started + seconds
-                while time.time() < deadline:
-                    if self._activity is not None:
+                activity_cadence = 0.5
+                next_activity = started
+                while True:
+                    now = time.time()
+                    if now >= deadline:
+                        break
+                    if self._activity is not None and now >= next_activity:
                         self._activity()
-                    else:
-                        time.sleep(min(0.1, deadline - time.time()))
+                        next_activity = time.time() + activity_cadence
+                    wakeup = deadline if self._activity is None else min(
+                        deadline, next_activity
+                    )
+                    time.sleep(max(0.0, min(0.1, wakeup - time.time())))
             finally:
                 jax.profiler.stop_trace()
             self._sweep_retention()

@@ -56,6 +56,31 @@ def test_retention_keeps_only_newest(tmp_path):
     assert [p.name for p in remaining] == ["trace-0002", "trace-0003"]
 
 
+def test_retention_is_numeric_past_trace_9999(tmp_path):
+    # Lexicographic ordering would sort trace-10000 before trace-1001 and
+    # retention would delete the capture it just wrote.
+    traces = tmp_path / "traces"
+    traces.mkdir()
+    for seq in (9998, 9999):
+        (traces / f"trace-{seq:04d}").mkdir()
+    cap = TraceCapture(str(tmp_path), keep=2)
+    doc = cap.capture(seconds=0.1)
+    assert doc["trace_dir"].endswith("trace-10000")
+    assert doc["files"] > 0
+    remaining = sorted(p.name for p in traces.iterdir())
+    assert remaining == ["trace-10000", "trace-9999"]
+
+
+def test_long_capture_sleeps_between_activity_runs(tmp_path):
+    # The synthetic activity exists to keep traces non-empty, not to close
+    # the window at 100% duty cycle — a 0.7s capture at the 0.5s cadence
+    # should run it twice (t=0 and t=0.5), not back-to-back.
+    calls = []
+    cap = TraceCapture(str(tmp_path), activity=lambda: calls.append(1))
+    cap.capture(seconds=0.7)
+    assert 1 <= len(calls) <= 3
+
+
 def test_concurrent_capture_is_refused(tmp_path):
     release = threading.Event()
 

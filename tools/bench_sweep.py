@@ -1,0 +1,58 @@
+"""Throughput sweep over flagship train-step variants on the visible devices.
+
+Measures tokens/s for combinations of attention kind, remat, and per-device
+batch so bench.py's defaults are chosen from data rather than guesses:
+
+    python tools/bench_sweep.py [--steps 6] [--seq 512]
+
+Uses bench.measure() so the sweep's numbers are directly comparable to the
+headline benchmark. Each variant compiles fresh (expect ~20-40s/compile on
+TPU the first time).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import itertools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import measure  # noqa: E402
+from __graft_entry__ import FLAGSHIP  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--attention", nargs="*", default=["naive", "flash"])
+    ap.add_argument("--batch", nargs="*", type=int, default=[16, 32, 64])
+    ap.add_argument("--remat", nargs="*", type=int, default=[1, 0])
+    args = ap.parse_args()
+
+    results = []
+    for attn, remat, bpd in itertools.product(
+        args.attention, args.remat, args.batch
+    ):
+        cfg = dataclasses.replace(FLAGSHIP, attention=attn, remat=bool(remat))
+        try:
+            tps, loss, _ = measure(cfg, bpd, args.seq, args.steps)
+        except Exception as e:  # OOM etc — report and keep sweeping
+            print(f"attn={attn:5s} remat={remat} bpd={bpd:3d}  FAILED: "
+                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+            continue
+        results.append((tps, attn, remat, bpd))
+        print(f"attn={attn:5s} remat={remat} bpd={bpd:3d}  "
+              f"{tps:10.0f} tok/s  loss={loss:.3f}", flush=True)
+
+    if results:
+        best = max(results)
+        print(f"\nbest: attn={best[1]} remat={best[2]} "
+              f"batch_per_device={best[3]}  {best[0]:.0f} tok/s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
