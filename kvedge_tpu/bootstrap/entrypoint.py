@@ -107,6 +107,16 @@ def main(argv: list[str] | None = None) -> int:
                         help="filesystem root to resolve in-pod paths against "
                              "(tests/local verification)")
     args = parser.parse_args(argv)
+    forced = os.environ.get("KVEDGE_FORCE_VIRTUAL_DEVICES", "")
+    if forced:
+        # Test/local-verification knob: run the whole boot against an
+        # n-device virtual CPU mesh. Must happen here — before any boot
+        # command can touch a JAX backend — because environments that
+        # preload jax pointed at real hardware ignore inherited env vars
+        # alone (see kvedge_tpu/testing/jaxenv.py).
+        from kvedge_tpu.testing.jaxenv import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(int(forced))
     try:
         run_boot_sequence(args.boot_config, root=args.root)
     except (BootDocError, CommandError, OSError) as e:

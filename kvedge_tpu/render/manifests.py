@@ -37,6 +37,7 @@ import dataclasses
 from kvedge_tpu.config.runtime_config import RuntimeConfig
 from kvedge_tpu.config.values import ChartValues
 from kvedge_tpu.render import bootconfig
+from kvedge_tpu.runtime.heartbeat import INIT_EVENTS_FILE
 from kvedge_tpu.render.names import (
     DOMAIN_LABEL,
     OS_LABEL,
@@ -62,6 +63,14 @@ TPU_RESOURCE = "google.com/tpu"
 TPU_CHIPS = 4
 
 STATE_MOUNT = "/var/lib/kvedge/state"
+# Native PID-1 supervisor (native/kvedge-init.cc): the in-container
+# analogue of the systemd level that supervises the payload inside the
+# reference VM, below the pod-restart level (the KubeVirt analogue).
+# Its event log lives on the state volume so supervision history survives
+# rescheduling; the status server surfaces it at /status. The filename is
+# owned by the runtime module that reads it back.
+INIT_BIN = "/opt/kvedge/bin/kvedge-init"
+INIT_EVENTS_PATH = f"{STATE_MOUNT}/{INIT_EVENTS_FILE}"
 SSH_PORT = 22
 # Default status port is owned by RuntimeConfig; the rendered containerPort /
 # Service / NOTES follow the operator's [status] port when a runtime config
@@ -216,6 +225,10 @@ def runtime_deployment(values: ChartValues) -> dict:
                             "name": "runtime",
                             "image": RUNTIME_IMAGE,
                             "command": [
+                                INIT_BIN,
+                                "--events",
+                                INIT_EVENTS_PATH,
+                                "--",
                                 "python",
                                 "-m",
                                 "kvedge_tpu.bootstrap.entrypoint",

@@ -54,6 +54,11 @@ class RuntimeHandle:
                 round(time.time() - last["ts"], 3) if "ts" in last else None
             ),
             "distributed": self.distributed.to_dict(),
+            # Supervision history from the native PID-1 supervisor
+            # (native/kvedge-init.cc) — restarts, give-ups, forwarded
+            # signals — persisted on the state volume across pod
+            # generations: the pod-world `systemctl status`.
+            "init_events": heartbeat.read_init_events(self.cfg.state_dir),
         }
 
     def shutdown(self) -> None:

@@ -18,6 +18,43 @@ from typing import Callable
 
 HEARTBEAT_FILE = "heartbeat.json"
 
+# Written by the native PID-1 supervisor (native/kvedge-init.cc) when the
+# pod command wraps the entrypoint with it; one JSON object per lifecycle
+# event, appended across pod generations. This module owns the filename so
+# the renderer (which wires the supervisor's --events flag) and the status
+# server (which tails the file) cannot drift.
+INIT_EVENTS_FILE = "init-events.jsonl"
+INIT_EVENTS_TAIL = 20
+# The file is append-only and never truncated; /status must stay O(1) no
+# matter how long a crash-loop history the volume carries, so only this
+# many trailing bytes are ever read.
+_INIT_EVENTS_READ_BYTES = 64 * 1024
+
+
+def read_init_events(state_dir: str, tail: int = INIT_EVENTS_TAIL) -> list:
+    """Last ``tail`` supervisor events, oldest first ([] if never written).
+
+    Reads a bounded tail of the file and skips unparseable lines rather
+    than failing: the first line of the window is usually cut mid-record,
+    and a crash can truncate the final line mid-write.
+    """
+    path = os.path.join(state_dir, INIT_EVENTS_FILE)
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - _INIT_EVENTS_READ_BYTES))
+            window = fh.read().decode("utf-8", errors="replace")
+    except OSError:
+        return []
+    events = []
+    for line in window.splitlines()[-tail:]:
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue
+    return events
+
 
 def read_heartbeat(state_dir: str) -> dict | None:
     """Read the last heartbeat, or None if absent/corrupt (fresh volume)."""

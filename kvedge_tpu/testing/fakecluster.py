@@ -27,7 +27,9 @@ from __future__ import annotations
 import base64
 import dataclasses
 import itertools
+import json
 import os
+import time
 
 
 @dataclasses.dataclass
@@ -308,6 +310,33 @@ class FakeCluster:
                 if not os.path.islink(target):
                     os.symlink(backing, target)
         command = container["command"]
+        if command and command[0].endswith("/kvedge-init"):
+            # The pod command wraps the entrypoint with the native PID-1
+            # supervisor (native/kvedge-init.cc). The fake cluster boots
+            # pods in-process, so it unwraps to the supervised child — but
+            # first records a supervisor-start event to the rebased events
+            # path, preserving the observable contract that a booted pod's
+            # /status carries init_events from its state volume.
+            if "--" not in command:
+                raise FakeClusterError(
+                    f"kvedge-init command without '--': {command}"
+                )
+            sep = command.index("--")
+            wrapper, command = command[1:sep], command[sep + 1:]
+            if "--events" in wrapper:
+                events_path = rebase(
+                    wrapper[wrapper.index("--events") + 1], scratch_dir
+                )
+                os.makedirs(os.path.dirname(events_path), exist_ok=True)
+                with open(events_path, "a", encoding="utf-8") as fh:
+                    fh.write(
+                        json.dumps({
+                            "ts": time.time(),
+                            "event": "supervisor-start",
+                            "fake": True,
+                            "pod": pod.name,
+                        }) + "\n"
+                    )
         if command[:3] != ["python", "-m", "kvedge_tpu.bootstrap.entrypoint"]:
             raise FakeClusterError(f"unexpected container command {command}")
         boot_config = command[command.index("--boot-config") + 1]

@@ -1,0 +1,310 @@
+// kvedge-init — native PID-1 supervisor for the runtime container.
+//
+// The reference runs its payload inside a full VM where *native* system
+// software owns process lifecycle: systemd supervises the IoT Edge daemon
+// (installed by cloud-init, reference _helper.tpl:68-74) and restarts it on
+// failure, while KubeVirt's `running: true` (aziot-edge-vm.yaml:9) restarts
+// the whole VM. The pod-world analogue keeps both levels: kvedge-init is
+// the in-container systemd analogue (supervise + restart-on-failure with
+// backoff, reap orphans, forward termination), and the Deployment's pod
+// restart is the KubeVirt analogue (kvedge-init exits non-zero when it
+// gives up, so Kubernetes recreates the pod).
+//
+// Why native and not Python: PID 1 in a container inherits kernel-level
+// duties — reaping re-parented orphans (the entrypoint starts sshd, whose
+// session children orphan grandchildren) and receiving SIGTERM with no
+// default handler installed. A supervisor must also stay alive and
+// responsive while the Python runtime is wedged in a C extension or being
+// OOM-killed, which is exactly when an in-process Python supervisor dies
+// with its payload.
+//
+// Usage:
+//   kvedge-init [--max-restarts N] [--backoff-ms MS] [--backoff-max-ms MS]
+//               [--grace-ms MS] [--events FILE] -- prog [args...]
+//
+// Behavior contract (tests/test_kvedge_init.py):
+//   * child runs in its own process group; SIGTERM/SIGINT to kvedge-init
+//     are forwarded to the group, then escalated to SIGKILL after
+//     --grace-ms (the terminationGracePeriod handshake);
+//   * exit 0 from the child ends supervision with exit 0 (run-to-
+//     completion payloads); non-zero restarts it up to --max-restarts
+//     times with exponential backoff, then exits with the child's code
+//     (128+signal for signal deaths) so the pod restart takes over;
+//   * any process re-parented to kvedge-init is reaped promptly
+//     (PR_SET_CHILD_SUBREAPER makes this testable without being PID 1);
+//   * every lifecycle event is appended to --events as one JSON line —
+//     the status server surfaces this file, the pod-level analogue of
+//     `systemctl status` inside the reference VM.
+
+#include <cerrno>
+#include <cinttypes>
+#include <cstdarg>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/prctl.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+struct Options {
+  long max_restarts = 5;
+  long backoff_ms = 500;
+  long backoff_max_ms = 30000;
+  long grace_ms = 10000;
+  std::string events_path;
+  std::vector<char *> child_argv;  // null-terminated for execvp
+};
+
+double now_unix() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<double>(ts.tv_sec) + static_cast<double>(ts.tv_nsec) / 1e9;
+}
+
+double now_mono_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) * 1e3 +
+         static_cast<double>(ts.tv_nsec) / 1e6;
+}
+
+// Append one JSON event line; best-effort (supervision must not fail
+// because the events file is unwritable).
+void emit_event(const Options &opts, const char *event, const char *fmt = "",
+                ...) {
+  char extra[256] = "";
+  if (fmt[0] != '\0') {
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(extra, sizeof extra, fmt, ap);
+    va_end(ap);
+  }
+  char line[512];
+  snprintf(line, sizeof line, "{\"ts\": %.3f, \"event\": \"%s\"%s%s}\n",
+           now_unix(), event, extra[0] ? ", " : "", extra);
+  fprintf(stderr, "[kvedge-init] %s", line);
+  if (opts.events_path.empty()) return;
+  int fd = open(opts.events_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd < 0) return;
+  ssize_t unused = write(fd, line, strlen(line));
+  (void)unused;
+  close(fd);
+}
+
+[[noreturn]] void usage_error(const char *msg) {
+  fprintf(stderr,
+          "kvedge-init: %s\n"
+          "usage: kvedge-init [--max-restarts N] [--backoff-ms MS] "
+          "[--backoff-max-ms MS] [--grace-ms MS] [--events FILE] -- prog "
+          "[args...]\n",
+          msg);
+  exit(64);  // EX_USAGE
+}
+
+long parse_long(const char *flag, const char *value) {
+  char *end = nullptr;
+  errno = 0;
+  long parsed = strtol(value, &end, 10);
+  if (errno != 0 || end == value || *end != '\0' || parsed < 0)
+    usage_error((std::string("bad value for ") + flag).c_str());
+  return parsed;
+}
+
+Options parse_args(int argc, char **argv) {
+  Options opts;
+  int i = 1;
+  for (; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "--") {
+      ++i;
+      break;
+    }
+    if (i + 1 >= argc) usage_error(("missing value for " + arg).c_str());
+    if (arg == "--max-restarts")
+      opts.max_restarts = parse_long("--max-restarts", argv[++i]);
+    else if (arg == "--backoff-ms")
+      opts.backoff_ms = parse_long("--backoff-ms", argv[++i]);
+    else if (arg == "--backoff-max-ms")
+      opts.backoff_max_ms = parse_long("--backoff-max-ms", argv[++i]);
+    else if (arg == "--grace-ms")
+      opts.grace_ms = parse_long("--grace-ms", argv[++i]);
+    else if (arg == "--events")
+      opts.events_path = argv[++i];
+    else
+      usage_error(("unknown flag " + arg).c_str());
+  }
+  for (; i < argc; ++i) opts.child_argv.push_back(argv[i]);
+  if (opts.child_argv.empty()) usage_error("no child command after --");
+  opts.child_argv.push_back(nullptr);
+  return opts;
+}
+
+pid_t spawn_child(const Options &opts, const sigset_t &orig_mask) {
+  pid_t pid = fork();
+  if (pid < 0) {
+    emit_event(opts, "fork-failed", "\"errno\": %d", errno);
+    return -1;
+  }
+  if (pid == 0) {
+    // Child: own process group (so the supervisor can signal the whole
+    // payload tree), original signal mask restored before exec.
+    setpgid(0, 0);
+    sigprocmask(SIG_SETMASK, &orig_mask, nullptr);
+    execvp(opts.child_argv[0], opts.child_argv.data());
+    fprintf(stderr, "[kvedge-init] exec %s failed: %s\n", opts.child_argv[0],
+            strerror(errno));
+    _exit(127);
+  }
+  // Also set the pgid from the parent side: whichever of the two races
+  // ahead, the group exists before we ever kill(-pid).
+  setpgid(pid, pid);
+  return pid;
+}
+
+int exit_code_of(int wstatus) {
+  if (WIFEXITED(wstatus)) return WEXITSTATUS(wstatus);
+  if (WIFSIGNALED(wstatus)) return 128 + WTERMSIG(wstatus);
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  Options opts = parse_args(argc, argv);
+
+  // Orphans re-parent to us even when we are not PID 1 (tests, or a
+  // container runtime that injects its own init above us).
+  prctl(PR_SET_CHILD_SUBREAPER, 1);
+
+  // Signal handling via sigtimedwait: block everything we manage and
+  // consume signals synchronously in the supervision loop — no handlers,
+  // no self-pipe, no async-signal-safety concerns.
+  sigset_t managed, orig_mask;
+  sigemptyset(&managed);
+  sigaddset(&managed, SIGTERM);
+  sigaddset(&managed, SIGINT);
+  sigaddset(&managed, SIGCHLD);
+  sigprocmask(SIG_BLOCK, &managed, &orig_mask);
+
+  long restarts_used = 0;
+  bool terminating = false;
+  double kill_deadline_ms = 0;     // escalation deadline while terminating
+  double restart_at_ms = 0;        // backoff deadline while child is down
+  long backoff_ms = opts.backoff_ms;
+  int last_status = 0;
+  pid_t dead_child_pgid = -1;      // failed attempt's group, killed pre-respawn
+
+  emit_event(opts, "supervisor-start", "\"pid\": %d, \"child\": \"%s\"",
+             getpid(), opts.child_argv[0]);
+  pid_t child = spawn_child(opts, orig_mask);
+  if (child < 0) return 1;
+  emit_event(opts, "child-start", "\"pid\": %d, \"attempt\": %ld", child,
+             restarts_used);
+
+  while (true) {
+    // Pick the nearest deadline (kill escalation or restart backoff).
+    struct timespec timeout;
+    struct timespec *timeout_ptr = nullptr;
+    double now = now_mono_ms();
+    double deadline = 0;
+    if (terminating && child > 0 && kill_deadline_ms > 0)
+      deadline = kill_deadline_ms;
+    else if (child < 0 && restart_at_ms > 0)
+      deadline = restart_at_ms;
+    if (deadline > 0) {
+      double wait_ms = deadline - now;
+      if (wait_ms < 0) wait_ms = 0;
+      timeout.tv_sec = static_cast<time_t>(wait_ms / 1000);
+      timeout.tv_nsec =
+          static_cast<long>((wait_ms - timeout.tv_sec * 1000) * 1e6);
+      timeout_ptr = &timeout;
+    }
+
+    siginfo_t info;
+    int sig = sigtimedwait(&managed, &info, timeout_ptr);
+    if (sig < 0 && errno == EINTR) continue;
+
+    if (sig == SIGTERM || sig == SIGINT) {
+      terminating = true;
+      if (child > 0) {
+        emit_event(opts, "forward-signal", "\"signal\": %d, \"pid\": %d", sig,
+                   child);
+        kill(-child, sig);
+        kill_deadline_ms = now_mono_ms() + static_cast<double>(opts.grace_ms);
+      } else {
+        // No child to wind down (we were in backoff): exit as if the
+        // child had been killed by this signal.
+        emit_event(opts, "terminated-in-backoff", "\"signal\": %d", sig);
+        return 128 + sig;
+      }
+    } else if (sig == SIGCHLD || sig < 0 /* timeout */) {
+      // Reap everything that is ready: our child and any re-parented
+      // orphans (subreaper duty).
+      while (true) {
+        int wstatus = 0;
+        pid_t reaped = waitpid(-1, &wstatus, WNOHANG);
+        if (reaped <= 0) break;
+        if (reaped != child) continue;  // orphan: reaped, nothing else to do
+        child = -1;
+        dead_child_pgid = reaped;
+        last_status = wstatus;
+        emit_event(opts, "child-exit", "\"code\": %d", exit_code_of(wstatus));
+        if (terminating) return exit_code_of(wstatus);
+        if (WIFEXITED(wstatus) && WEXITSTATUS(wstatus) == 0) {
+          emit_event(opts, "supervisor-exit", "\"code\": 0");
+          return 0;  // run-to-completion payload finished
+        }
+        if (restarts_used >= opts.max_restarts) {
+          emit_event(opts, "give-up", "\"restarts\": %ld, \"code\": %d",
+                     restarts_used, exit_code_of(wstatus));
+          return exit_code_of(wstatus);
+        }
+        restart_at_ms = now_mono_ms() + static_cast<double>(backoff_ms);
+        emit_event(opts, "restart-scheduled",
+                   "\"backoff_ms\": %ld, \"attempt\": %ld", backoff_ms,
+                   restarts_used + 1);
+        backoff_ms = backoff_ms * 2;
+        if (backoff_ms > opts.backoff_max_ms) backoff_ms = opts.backoff_max_ms;
+      }
+
+      // Deadlines that may have fired with the timeout.
+      now = now_mono_ms();
+      if (terminating && child > 0 && kill_deadline_ms > 0 &&
+          now >= kill_deadline_ms) {
+        emit_event(opts, "escalate-sigkill", "\"pid\": %d", child);
+        kill(-child, SIGKILL);
+        kill_deadline_ms = 0;  // waitpid via SIGCHLD will finish up
+      }
+      if (!terminating && child < 0 && restart_at_ms > 0 &&
+          now >= restart_at_ms) {
+        restart_at_ms = 0;
+        ++restarts_used;
+        // Sweep the failed attempt's process group before respawning:
+        // survivors (a wedged runtime still holding the TPU device, a
+        // Popen'd sshd on port 22) would otherwise make every restart
+        // fail on a conflict the supervisor itself preserved. This is
+        // the cgroup-kill systemd performs before a service restart.
+        if (dead_child_pgid > 0) {
+          if (kill(-dead_child_pgid, SIGKILL) == 0)
+            emit_event(opts, "sweep-stale-group", "\"pgid\": %d",
+                       dead_child_pgid);
+          dead_child_pgid = -1;
+        }
+        child = spawn_child(opts, orig_mask);
+        if (child < 0) return 1;
+        emit_event(opts, "child-start", "\"pid\": %d, \"attempt\": %ld", child,
+                   restarts_used);
+      }
+      if (terminating && child < 0) return exit_code_of(last_status);
+    }
+  }
+}

@@ -6,6 +6,25 @@ on 8 virtual CPU devices per the build environment contract. See
 jax.config, before any backend init) is load-bearing.
 """
 
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
 from kvedge_tpu.testing.jaxenv import force_virtual_cpu_devices
 
 force_virtual_cpu_devices(8)
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+
+
+@pytest.fixture(scope="session")
+def kvedge_init() -> pathlib.Path:
+    """The compiled native PID-1 supervisor (native/kvedge-init.cc)."""
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain in this environment")
+    subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR)], check=True, capture_output=True
+    )
+    return _NATIVE_DIR / "build" / "kvedge-init"

@@ -9,6 +9,10 @@ reachable. The analogue of: VM boots, `iotedge config apply` succeeds,
 
 import base64
 import json
+import os
+import pathlib
+import subprocess
+import sys
 import urllib.request
 
 import yaml
@@ -67,7 +71,7 @@ def _materialize_pod_fs(tmp_path, chart):
     return container
 
 
-def test_end_to_end_boot(tmp_path):
+def test_end_to_end_boot(tmp_path, kvedge_init):
     values = DEFAULT_VALUES.replace(
         publicSshKey="ssh-ed25519 E2EKEY op@laptop",
         jaxRuntimeConfig=RUNTIME_TOML,
@@ -75,14 +79,26 @@ def test_end_to_end_boot(tmp_path):
     chart = render_all(values)
     container = _materialize_pod_fs(tmp_path, chart)
 
-    # The rendered container command is the entrypoint contract; run exactly
-    # what the pod would run (in-process, with --root + --once for the test).
-    assert container["command"][:3] == ["python", "-m",
-                                        "kvedge_tpu.bootstrap.entrypoint"]
-    boot_config_arg = container["command"][
-        container["command"].index("--boot-config") + 1
-    ]
+    # The rendered container command is the pod's contract: the native
+    # PID-1 supervisor wrapping the Python entrypoint. Run exactly that —
+    # the real compiled kvedge-init supervising the real entrypoint as a
+    # subprocess — rebasing the two absolute paths the supervisor itself
+    # consumes (the events file; --root handles every path *inside* the
+    # boot sequence).
+    command = list(container["command"])
+    assert command[0] == "/opt/kvedge/bin/kvedge-init"
+    sep = command.index("--")
+    wrapper, child = command[1:sep], command[sep + 1:]
+    assert child[:3] == ["python", "-m", "kvedge_tpu.bootstrap.entrypoint"]
+
+    events_path = tmp_path / "init-events.jsonl"
+    wrapper[wrapper.index("--events") + 1] = str(events_path)
+
+    child[0] = sys.executable  # the pod's PATH `python` is this interpreter
+    boot_config_arg = child[child.index("--boot-config") + 1]
     boot_path = tmp_path / boot_config_arg.lstrip("/")
+    child[child.index("--boot-config") + 1] = str(boot_path)
+    child += ["--root", str(tmp_path)]
 
     # Append --once to the final runcmd so the heartbeat loop doesn't block.
     original = boot_path.read_text()
@@ -93,10 +109,21 @@ def test_end_to_end_boot(tmp_path):
     assert doc != original, "rendered runcmd wording changed; fix this patch"
     boot_path.write_text(doc)
 
-    rc = entrypoint_main(
-        ["--boot-config", str(boot_path), "--root", str(tmp_path)]
+    env = dict(os.environ, KVEDGE_FORCE_VIRTUAL_DEVICES="8")
+    proc = subprocess.run(
+        [str(kvedge_init), *wrapper, "--", *child],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
     )
-    assert rc == 0
+    assert proc.returncode == 0, proc.stderr
+
+    # The supervisor recorded the full lifecycle of a clean one-shot boot.
+    events = [
+        json.loads(line) for line in events_path.read_text().splitlines()
+    ]
+    assert [e["event"] for e in events] == [
+        "supervisor-start", "child-start", "child-exit", "supervisor-exit"
+    ]
 
     # Config located by serial and applied.
     assert (tmp_path / "mnt/app-secret/userdata").read_text() == RUNTIME_TOML
@@ -115,6 +142,42 @@ def test_end_to_end_boot(tmp_path):
     assert beat["boot_count"] == 1
     assert beat["check"]["device_count"] == 8
     assert beat["check"]["mesh_shape"] == [2, 4]  # data axis inferred
+
+
+def test_end_to_end_boot_in_process(tmp_path):
+    """The same boot path without the native supervisor.
+
+    Runs the entrypoint in-process so the full render -> boot-config ->
+    locate/apply -> runtime slice stays covered even in environments with
+    no C++ toolchain (where the supervised variant above skips).
+    """
+    values = DEFAULT_VALUES.replace(
+        publicSshKey="ssh-ed25519 E2EKEY op@laptop",
+        jaxRuntimeConfig=RUNTIME_TOML,
+    )
+    chart = render_all(values)
+    container = _materialize_pod_fs(tmp_path, chart)
+    command = list(container["command"])
+    child = command[command.index("--") + 1:]
+    assert child[:3] == ["python", "-m", "kvedge_tpu.bootstrap.entrypoint"]
+
+    boot_path = tmp_path / child[child.index("--boot-config") + 1].lstrip("/")
+    original = boot_path.read_text()
+    doc = original.replace(
+        '"kvedge-runtime boot --config /etc/kvedge/config.toml"',
+        '"kvedge-runtime boot --once --config /etc/kvedge/config.toml"',
+    )
+    assert doc != original, "rendered runcmd wording changed; fix this patch"
+    boot_path.write_text(doc)
+
+    rc = entrypoint_main(
+        ["--boot-config", str(boot_path), "--root", str(tmp_path)]
+    )
+    assert rc == 0
+    beat = json.loads(
+        (tmp_path / "var/lib/kvedge/state/heartbeat.json").read_text()
+    )
+    assert beat["ok"] is True and beat["boot_count"] == 1
 
 
 def test_end_to_end_missing_config_volume_fails_loudly(tmp_path, capsys):

@@ -1,0 +1,221 @@
+"""kvedge-init: the native PID-1 supervisor (native/kvedge-init.cc).
+
+The reference delegates process lifecycle to *native* system software
+inside its VM — systemd supervises the IoT Edge daemon that cloud-init
+installs (reference ``_helper.tpl:68-74``), and KubeVirt's
+``running: true`` restarts the whole VM (``aziot-edge-vm.yaml:9``).
+kvedge-init is the in-container analogue of the systemd level; these
+tests pin its behavior contract: restart-on-failure with backoff,
+exit-code propagation (so the pod-restart level can take over),
+SIGTERM forwarding with SIGKILL escalation, and orphan reaping.
+"""
+
+import json
+import signal
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+# The compiled binary comes from the session-scoped ``kvedge_init``
+# fixture in conftest.py (shared with the end-to-end slice test).
+
+
+def run_init(kvedge_init, *args, timeout=30, **kwargs):
+    return subprocess.run(
+        [str(kvedge_init), *args],
+        capture_output=True, text=True, timeout=timeout, **kwargs
+    )
+
+
+def read_events(path: Path) -> list[dict]:
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+def test_success_exit_is_not_restarted(kvedge_init, tmp_path):
+    events = tmp_path / "events.jsonl"
+    proc = run_init(
+        kvedge_init, "--events", str(events), "--backoff-ms", "10", "--",
+        "/bin/sh", "-c", "echo payload-ran",
+    )
+    assert proc.returncode == 0
+    assert "payload-ran" in proc.stdout
+    names = [e["event"] for e in read_events(events)]
+    assert names == [
+        "supervisor-start", "child-start", "child-exit", "supervisor-exit"
+    ]
+
+
+def test_restarts_on_failure_until_success(kvedge_init, tmp_path):
+    # Child fails until its third run: a counter file stands in for a
+    # transiently-broken payload (e.g. the TPU device not yet released by
+    # a dying predecessor pod).
+    counter = tmp_path / "count"
+    events = tmp_path / "events.jsonl"
+    script = f"n=$(cat {counter} 2>/dev/null || echo 0); " \
+             f"echo $((n+1)) > {counter}; [ $n -ge 2 ]"
+    proc = run_init(
+        kvedge_init, "--events", str(events), "--backoff-ms", "20",
+        "--max-restarts", "5", "--", "/bin/sh", "-c", script,
+    )
+    assert proc.returncode == 0
+    assert counter.read_text().strip() == "3"
+    starts = [e for e in read_events(events) if e["event"] == "child-start"]
+    assert [s["attempt"] for s in starts] == [0, 1, 2]
+
+
+def test_gives_up_after_max_restarts_and_propagates_code(
+    kvedge_init, tmp_path
+):
+    events = tmp_path / "events.jsonl"
+    proc = run_init(
+        kvedge_init, "--events", str(events), "--backoff-ms", "10",
+        "--max-restarts", "2", "--", "/bin/sh", "-c", "exit 9",
+    )
+    assert proc.returncode == 9
+    evs = read_events(events)
+    give_up = [e for e in evs if e["event"] == "give-up"]
+    assert give_up and give_up[0]["restarts"] == 2 and give_up[0]["code"] == 9
+    # exponential backoff is visible in the scheduled waits
+    backoffs = [e["backoff_ms"] for e in evs
+                if e["event"] == "restart-scheduled"]
+    assert backoffs == [10, 20]
+
+
+def test_exec_failure_exits_127_after_restart_budget(kvedge_init, tmp_path):
+    proc = run_init(
+        kvedge_init, "--backoff-ms", "5", "--max-restarts", "1", "--",
+        str(tmp_path / "no-such-binary"),
+    )
+    assert proc.returncode == 127
+
+
+def test_sigterm_is_forwarded_to_the_child(kvedge_init, tmp_path):
+    # Child traps TERM, writes a marker, exits 7 — kvedge-init must
+    # forward the signal and propagate the child's own exit code.
+    marker = tmp_path / "got-term"
+    events = tmp_path / "events.jsonl"
+    script = f"trap 'touch {marker}; exit 7' TERM; " \
+             "echo ready; while true; do sleep 0.05; done"
+    proc = subprocess.Popen(
+        [str(kvedge_init), "--events", str(events), "--", "/bin/sh", "-c",
+         script],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    assert proc.stdout.readline().strip() == "ready"
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=10) == 7
+    assert marker.exists()
+    names = [e["event"] for e in read_events(events)]
+    assert "forward-signal" in names and "give-up" not in names
+
+
+def test_sigkill_escalation_when_child_ignores_term(kvedge_init, tmp_path):
+    events = tmp_path / "events.jsonl"
+    script = "trap '' TERM; echo ready; while true; do sleep 0.05; done"
+    proc = subprocess.Popen(
+        [str(kvedge_init), "--events", str(events), "--grace-ms", "300",
+         "--", "/bin/sh", "-c", script],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    assert proc.stdout.readline().strip() == "ready"
+    start = time.monotonic()
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=10) == 128 + signal.SIGKILL
+    assert time.monotonic() - start >= 0.3  # the grace window was honored
+    names = [e["event"] for e in read_events(events)]
+    assert "escalate-sigkill" in names
+
+
+def test_reparented_orphans_are_reaped(kvedge_init, tmp_path):
+    # The payload double-forks an orphan (sshd-session style); the orphan
+    # re-parents to kvedge-init (child subreaper) and dies while the main
+    # child is still running. kvedge-init must reap it — a Python PID 1
+    # would leave it as a zombie.
+    orphan_pid_file = tmp_path / "orphan.pid"
+    script = (
+        f"( sleep 0.3 & echo $! > {orphan_pid_file} ) & "
+        "echo ready; sleep 2; exit 0"
+    )
+    proc = subprocess.Popen(
+        [str(kvedge_init), "--", "/bin/sh", "-c", script],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        deadline = time.monotonic() + 5
+        while not orphan_pid_file.exists() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        orphan_pid = int(orphan_pid_file.read_text().strip())
+        # Wait for the orphan to die, then confirm it is fully reaped
+        # (no zombie): a reaped pid has no /proc entry; a zombie does,
+        # with state Z.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                stat = Path(f"/proc/{orphan_pid}/stat").read_text()
+            except (FileNotFoundError, ProcessLookupError):
+                break  # gone entirely: reaped
+            if f") Z " not in stat.split(maxsplit=1)[1]:
+                time.sleep(0.02)  # still alive, keep waiting
+                continue
+            time.sleep(0.02)  # zombie: give the supervisor a beat to reap
+        else:
+            pytest.fail(f"orphan {orphan_pid} left as a zombie")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_stale_process_group_is_killed_before_respawn(kvedge_init, tmp_path):
+    # A failed attempt can leave survivors in its process group (a wedged
+    # runtime still holding the TPU device, a spawned sshd on port 22).
+    # The supervisor must SIGKILL the old group before respawning — the
+    # cgroup-kill systemd does — or every restart inherits the conflict.
+    survivor_pid = tmp_path / "survivor.pid"
+    events = tmp_path / "events.jsonl"
+    script = (
+        # First attempt: leave a long-lived survivor in our pgroup, fail.
+        f"if [ ! -e {survivor_pid} ]; then "
+        f"  sleep 60 & echo $! > {survivor_pid}; exit 1; "
+        "fi; "
+        # Second attempt: the survivor must be gone.
+        f"if kill -0 $(cat {survivor_pid}) 2>/dev/null; then exit 9; fi; "
+        "exit 0"
+    )
+    proc = run_init(
+        kvedge_init, "--events", str(events), "--backoff-ms", "100",
+        "--max-restarts", "3", "--", "/bin/sh", "-c", script,
+    )
+    assert proc.returncode == 0, proc.stderr
+    names = [e["event"] for e in read_events(events)]
+    assert "sweep-stale-group" in names
+
+
+def test_term_during_backoff_exits_immediately(kvedge_init, tmp_path):
+    events = tmp_path / "events.jsonl"
+    proc = subprocess.Popen(
+        [str(kvedge_init), "--events", str(events), "--backoff-ms", "5000",
+         "--max-restarts", "3", "--", "/bin/sh", "-c", "exit 3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if events.exists() and any(
+            e["event"] == "restart-scheduled" for e in read_events(events)
+        ):
+            break
+        time.sleep(0.02)
+    start = time.monotonic()
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=10) == 128 + signal.SIGTERM
+    assert time.monotonic() - start < 2  # did not sit out the 5s backoff
+
+
+def test_bad_usage_exits_64(kvedge_init):
+    assert run_init(kvedge_init).returncode == 64
+    assert run_init(kvedge_init, "--max-restarts", "nope", "--",
+                    "/bin/true").returncode == 64
+    assert run_init(kvedge_init, "--mystery-flag", "1", "--",
+                    "/bin/true").returncode == 64

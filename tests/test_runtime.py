@@ -104,6 +104,39 @@ def test_status_endpoints(tmp_path):
         handle.shutdown()
 
 
+def test_status_surfaces_supervisor_events(tmp_path):
+    # The native PID-1 supervisor (native/kvedge-init.cc) appends JSON
+    # lines to init-events.jsonl on the state volume; /status tails them —
+    # the pod-world `systemctl status`. A line truncated by a crash
+    # mid-write must be skipped, not fail the endpoint.
+    cfg = _cfg(tmp_path)
+    events_path = tmp_path / "state" / "init-events.jsonl"
+    events_path.parent.mkdir(parents=True, exist_ok=True)
+    events_path.write_text(
+        '{"ts": 1.0, "event": "supervisor-start", "pid": 1}\n'
+        '{"ts": 2.0, "event": "child-start", "pid": 7, "attempt": 0}\n'
+        '{"ts": 3.0, "event": "child-exit", "co'  # truncated mid-write
+    )
+    handle = start_runtime(cfg)
+    try:
+        code, doc = _get(handle.status_port, "/status")
+        assert code == 200
+        assert [e["event"] for e in doc["init_events"]] == [
+            "supervisor-start", "child-start"
+        ]
+    finally:
+        handle.shutdown()
+
+
+def test_status_init_events_absent_is_empty_list(tmp_path):
+    handle = start_runtime(_cfg(tmp_path))
+    try:
+        code, doc = _get(handle.status_port, "/status")
+        assert code == 200 and doc["init_events"] == []
+    finally:
+        handle.shutdown()
+
+
 def test_status_degraded_on_failed_check(tmp_path):
     import urllib.error
 
