@@ -34,6 +34,9 @@ DEFAULT_STATE_DIR = "/var/lib/kvedge/state"
 _VALID_PAYLOADS = (
     "devicecheck", "transformer-probe", "inference-probe", "none",
 )
+# "" = auto (ring iff the mesh declares a seq axis); the rest match
+# TransformerConfig.attention (models/transformer.py).
+_VALID_ATTENTION = ("", "naive", "flash", "ring", "ulysses")
 
 
 class RuntimeConfigError(ValueError):
@@ -139,6 +142,11 @@ class RuntimeConfig:
     status_port: int = 8476
     status_bind: str = "0.0.0.0"
     payload: str = "devicecheck"
+    # Attention mode for the transformer-probe payload. "" = auto: the
+    # ring when the mesh has a seq axis, naive otherwise. Explicit values
+    # select a specific sequence-parallel strategy ("ring"/"ulysses") or
+    # kernel ("flash"/"naive").
+    payload_attention: str = ""
 
     @classmethod
     def parse(cls, text: str) -> "RuntimeConfig":
@@ -193,6 +201,9 @@ class RuntimeConfig:
                 status_port=int(status.get("port", cls.status_port)),
                 status_bind=str(status.get("bind", cls.status_bind)),
                 payload=str(payload_doc.get("kind", cls.payload)),
+                payload_attention=str(
+                    payload_doc.get("attention", cls.payload_attention)
+                ),
             )
         except (TypeError, ValueError) as e:
             if isinstance(e, RuntimeConfigError):
@@ -215,6 +226,11 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 f"[payload] kind must be one of {_VALID_PAYLOADS}, "
                 f"got {self.payload!r}"
+            )
+        if self.payload_attention not in _VALID_ATTENTION:
+            raise RuntimeConfigError(
+                f"[payload] attention must be one of {_VALID_ATTENTION}, "
+                f"got {self.payload_attention!r}"
             )
         self.mesh.validate()
         self.distributed.validate()
@@ -248,6 +264,7 @@ class RuntimeConfig:
             f"bind = {s(self.status_bind)}\n"
             "\n[payload]\n"
             f"kind = {s(self.payload)}\n"
+            f"attention = {s(self.payload_attention)}\n"
         )
 
     def apply(self, config_path: str = DEFAULT_CONFIG_PATH) -> str:

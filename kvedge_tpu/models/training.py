@@ -47,8 +47,8 @@ def run_training(
     dir: a rerun after a crash picks up where the checkpoint left off and
     returns immediately if the target was already reached. ``prepare``
     lets callers shard the (restored or fresh) state onto a mesh;
-    ``mesh`` is required when ``cfg.attention == 'ring'`` (see
-    :func:`make_train_step`).
+    ``mesh`` is required for the sequence-parallel attention modes
+    (``'ring'``/``'ulysses'``; see :func:`make_train_step`).
     """
     init_opt, train_step = make_train_step(cfg, optimizer=optimizer, mesh=mesh)
     step = 0

@@ -44,9 +44,12 @@ class TransformerConfig:
     # "naive" materializes [T, T] scores (XLA-fused); "flash" streams K/V
     # blocks through a Pallas kernel with an online softmax (no [T, T] in
     # forward); "ring" shards the sequence over the mesh's ``seq`` axis
-    # with ppermute rotation (parallel/ringattention.py) — long-context
-    # mode, requires passing a mesh with a ``seq`` axis to forward().
-    # Flash requires seq to be a multiple of its block size.
+    # with ppermute rotation (parallel/ringattention.py); "ulysses"
+    # shards the sequence too, but re-shards heads<->sequence with one
+    # all-to-all each way and attends locally (parallel/ulysses.py —
+    # needs n_heads % sp == 0). Both sequence modes require passing a
+    # mesh with a ``seq`` axis to forward(). Flash requires seq to be a
+    # multiple of its block size.
     attention: str = "naive"
 
     @property
@@ -63,10 +66,10 @@ class TransformerConfig:
             raise ValueError("d_model must be divisible by n_heads")
         if self.n_kv_heads and self.n_heads % self.n_kv_heads:
             raise ValueError("n_heads must be divisible by n_kv_heads")
-        if self.attention not in ("naive", "flash", "ring"):
+        if self.attention not in ("naive", "flash", "ring", "ulysses"):
             raise ValueError(
-                "attention must be 'naive', 'flash', or 'ring', "
-                f"got {self.attention!r}"
+                "attention must be 'naive', 'flash', 'ring', or "
+                f"'ulysses', got {self.attention!r}"
             )
 
 
@@ -170,15 +173,20 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
         # K/V is materialized in HBM.
         k = jnp.repeat(k, h // kv, axis=2)
         v = jnp.repeat(v, h // kv, axis=2)
-    if cfg.attention == "ring":
-        from kvedge_tpu.parallel.ringattention import ring_attention
-
+    if cfg.attention in ("ring", "ulysses"):
         if mesh is None:
             raise ValueError(
-                "attention='ring' needs a mesh with a 'seq' axis passed to "
-                "forward()/make_train_step()"
+                f"attention={cfg.attention!r} needs a mesh with a 'seq' "
+                "axis passed to forward()/make_train_step()"
             )
-        attended = ring_attention(q, k, v, mesh)
+        if cfg.attention == "ring":
+            from kvedge_tpu.parallel.ringattention import ring_attention
+
+            attended = ring_attention(q, k, v, mesh)
+        else:
+            from kvedge_tpu.parallel.ulysses import ulysses_attention
+
+            attended = ulysses_attention(q, k, v, mesh)
         attended = attended.reshape(batch, seq, h * dh)
     elif cfg.attention == "flash":
         from kvedge_tpu.ops.attention import flash_attention, pick_block
@@ -218,16 +226,17 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
 def forward(params: dict, tokens, cfg: TransformerConfig, mesh=None):
     """tokens [B, T] int32 -> logits [B, T, V] (fp32).
 
-    ``mesh`` is only needed for ``attention='ring'`` (sequence
-    parallelism); when given, activations are pinned seq-sharded between
-    layers so the LN/MLP work stays sequence-parallel too.
+    ``mesh`` is only needed for the sequence-parallel attention modes
+    (``'ring'``/``'ulysses'``); when given, activations are pinned
+    seq-sharded between layers so the LN/MLP work stays sequence-parallel
+    too.
     """
     dtype = jnp.dtype(cfg.dtype)
     embedding = params["embedding"]
     x = embedding[tokens].astype(dtype)  # [B, T, D]
 
     constrain = None
-    if cfg.attention == "ring" and mesh is not None:
+    if cfg.attention in ("ring", "ulysses") and mesh is not None:
         from kvedge_tpu.parallel.ringattention import sequence_sharding
 
         sharding = sequence_sharding(mesh)
@@ -273,9 +282,10 @@ def loss_fn(params: dict, batch, cfg: TransformerConfig, mesh=None):
 def make_train_step(cfg: TransformerConfig, optimizer=None, mesh=None):
     """Build (init_opt_state, train_step). Donates params/opt_state buffers.
 
-    ``mesh`` is required when ``cfg.attention == 'ring'`` (the ring's
-    shard_map needs the concrete mesh); otherwise sharding stays
-    annotation-only and the mesh argument is unused.
+    ``mesh`` is required for the sequence-parallel attention modes
+    (``'ring'``/``'ulysses'`` — their shard_map needs the concrete mesh);
+    otherwise sharding stays annotation-only and the mesh argument is
+    unused.
     """
     import optax
 

@@ -10,6 +10,7 @@ communication.
 
 from kvedge_tpu.parallel.mesh import build_mesh, local_mesh
 from kvedge_tpu.parallel.ringattention import ring_attention, sequence_sharding
+from kvedge_tpu.parallel.ulysses import ulysses_attention
 from kvedge_tpu.parallel.sharding import (
     batch_spec,
     param_specs,
@@ -26,4 +27,5 @@ __all__ = [
     "sequence_sharding",
     "shard_params",
     "shard_batch",
+    "ulysses_attention",
 ]

@@ -50,23 +50,37 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
     mesh = build_mesh(cfg.mesh)
     axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
     model_axis = axis_sizes.get("model", 1)
-    # A `seq` axis in the operator's mesh selects the long-context path:
-    # the probe then exercises ring attention's ppermute ring, not just
-    # the annotation-sharded dp×tp step.
-    ring = axis_sizes.get("seq", 1) > 1
-    tcfg = TransformerConfig(
-        vocab=PROBE_VOCAB,
-        d_model=PROBE_D_MODEL,
-        n_heads=max(4, model_axis),
-        n_layers=PROBE_LAYERS,
-        d_ff=4 * PROBE_D_MODEL,
-        max_seq=PROBE_SEQ,
-        attention="ring" if ring else "naive",
-    )
+    sp = axis_sizes.get("seq", 1)
+    # A `seq` axis in the operator's mesh selects the long-context path —
+    # ring attention's ppermute ring by default, or the strategy named by
+    # [payload] attention ("ulysses" = all-to-all head scatter). Either
+    # way the probe exercises real sequence-parallel collectives, not
+    # just the annotation-sharded dp×tp step.
+    attention = cfg.payload_attention or ("ring" if sp > 1 else "naive")
+    sequence_parallel = attention in ("ring", "ulysses")
+    n_heads = max(4, model_axis)
+    if attention == "ulysses" and n_heads % sp:
+        # Ulysses scatters heads over the seq axis: round up to the next
+        # multiple of sp.
+        n_heads = sp * -(-n_heads // sp)
     try:
+        # Inside the try: an sp-derived head count can make the model
+        # config itself invalid (d_model % n_heads), and that must surface
+        # as a structured probe failure like every other error here.
+        tcfg = TransformerConfig(
+            vocab=PROBE_VOCAB,
+            d_model=PROBE_D_MODEL,
+            n_heads=n_heads,
+            n_layers=PROBE_LAYERS,
+            d_ff=4 * PROBE_D_MODEL,
+            max_seq=PROBE_SEQ,
+            attention=attention,
+        )
         key = jax.random.PRNGKey(0)
         params = shard_params(mesh, init_params(key, tcfg))
-        init_opt, train_step = make_train_step(tcfg, mesh=mesh if ring else None)
+        init_opt, train_step = make_train_step(
+            tcfg, mesh=mesh if sequence_parallel else None
+        )
         opt_state = init_opt(params)
         batch = shard_batch(
             mesh,

@@ -275,6 +275,23 @@ def test_transformer_probe_ring_on_seq_mesh(tmp_path):
     assert math.isfinite(result.probe_checksum)
 
 
+def test_transformer_probe_ulysses_via_config(tmp_path):
+    """[payload] attention = 'ulysses' selects the all-to-all strategy."""
+    import math
+
+    from kvedge_tpu.config.runtime_config import MeshSpec
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    cfg = _cfg(
+        tmp_path,
+        mesh=MeshSpec(axes=(("data", 2), ("seq", 4))),
+        payload_attention="ulysses",
+    )
+    result = run_transformer_probe(cfg)
+    assert result.ok, result.error
+    assert math.isfinite(result.probe_checksum)
+
+
 def test_status_server_answers_during_boot_work(tmp_path, monkeypatch):
     """The server must serve /version while the boot work is in flight.
 

@@ -54,6 +54,15 @@ def test_invalid_toml_and_values():
         RuntimeConfig.parse("[status]\nport = 99999\n")
     with pytest.raises(RuntimeConfigError):
         RuntimeConfig.parse("[runtime]\nheartbeat_interval_s = 0\n")
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse("[payload]\nattention = 'quadratic'\n")
+
+
+def test_payload_attention_round_trips():
+    cfg = RuntimeConfig.parse("[payload]\nattention = 'ulysses'\n")
+    assert cfg.payload_attention == "ulysses"
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    assert RuntimeConfig.parse("").payload_attention == ""  # auto
 
 
 def test_mesh_resolution():
