@@ -17,6 +17,16 @@ app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- end -}}
 
 {{/*
+The `helm test` notice for NOTES.txt — one definition shared by both
+gating branches (multi-host: always; single-host: only with the access
+Service), so the wording cannot drift between them.
+*/}}
+{{- define "kvedgetpu.helmtestnotice" }}
+To verify the runtime from inside the cluster:
+helm test <release-name>
+{{- end -}}
+
+{{/*
 The boot-config document for the runtime container — the cloud-init
 user-data analogue. Must stay byte-identical to
 kvedge_tpu/render/bootconfig.py:boot_config_document (the consistency test

@@ -13,6 +13,9 @@ kvedge-tpu manifest                             reference template
 ``jax-tpu-runtime-config-secret.yaml``          ``aziot-edge-runtime-config-secret.yaml``
 ``jax-tpu-boot-config-secret.yaml``             ``aziot-edge-vm-cloud-init-secret.yaml``
 ``jax-tpu-runtime-service.yaml`` (conditional)  ``aziot-edge-vm-service.yaml``
+``jax-tpu-healthz-test.yaml`` /                 — (no reference analogue; the
+``jax-tpu-healthz-test-multihost.yaml``           reference verifies by hand,
+  (conditional ``helm test`` hook Pod)            its ``NOTES.txt:8-12``)
 ==============================================  ================================
 
 With ``tpuNumHosts > 1`` the Deployment + PVC pair is replaced by
@@ -497,6 +500,59 @@ def access_service(values: ChartValues) -> dict | None:
     }
 
 
+def healthz_test_pod(values: ChartValues) -> dict | None:
+    """``helm test`` hook Pod: polls the runtime's /healthz in-cluster.
+
+    The reference's post-install verification is manual (``kubectl get
+    vmi`` + ssh, reference ``NOTES.txt:8-12``; no helm test hooks exist —
+    SURVEY.md §4). This hook automates it: ``helm test <release>`` runs
+    the runtime image's :mod:`kvedge_tpu.runtime.healthcheck` against the
+    runtime's stable in-cluster DNS name — the multi-host headless
+    per-pod name when ``tpuNumHosts > 1``, the access Service otherwise.
+    A single-host install with the access Service disabled has no stable
+    DNS target, so no hook renders (``helm test`` then reports no tests,
+    matching the reference's "verify by hand" posture).
+    """
+    name = resource_name(values.nameOverride)
+    port = status_port(values)
+    if values.tpuNumHosts > 1:
+        host = f"{name}-runtime-0.{name}-runtime-hosts"
+    elif values.tpuRuntimeEnableExternalSsh:
+        host = f"{name}-runtime-ssh-service"
+    else:
+        return None
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "labels": common_labels(),
+            "annotations": {
+                "helm.sh/hook": "test",
+                "helm.sh/hook-delete-policy":
+                    "before-hook-creation,hook-succeeded",
+            },
+            "name": f"{name}-runtime-healthz-test",
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [
+                {
+                    "name": "healthz",
+                    "image": RUNTIME_IMAGE,
+                    "command": [
+                        "python",
+                        "-m",
+                        "kvedge_tpu.runtime.healthcheck",
+                        f"http://{host}:{port}/healthz",
+                        "--deadline",
+                        "240",
+                    ],
+                }
+            ],
+        },
+    }
+
+
 @dataclasses.dataclass(frozen=True)
 class RenderedChart:
     """The rendered manifest set, keyed by output filename."""
@@ -526,6 +582,11 @@ def render_notes(values: ChartValues) -> str:
         "To connect to the runtime pod over SSH:\n"
         f"ssh kvedge@$(kubectl get service {name}-runtime-ssh-service "
         "--output jsonpath='{.status.loadBalancer.ingress[0].ip}')\n"
+    ) + (
+        "\n"
+        "To verify the runtime from inside the cluster:\n"
+        "helm test <release-name>\n"
+        if healthz_test_pod(values) is not None else ""
     )
 
 
@@ -558,4 +619,9 @@ def render_all(values: ChartValues, include_dead: bool = False) -> RenderedChart
     service = access_service(values)
     if service is not None:
         manifests["jax-tpu-runtime-service.yaml"] = service
+    test_pod = healthz_test_pod(values)
+    if test_pod is not None:
+        key = ("jax-tpu-healthz-test.yaml" if values.tpuNumHosts == 1
+               else "jax-tpu-healthz-test-multihost.yaml")
+        manifests[key] = test_pod
     return RenderedChart(manifests=manifests, notes=render_notes(values))

@@ -1,0 +1,64 @@
+"""Polling health probe: the payload of the ``helm test`` hook pod.
+
+The reference's post-install verification is entirely manual —
+``kubectl get vmi`` then ssh in (reference ``NOTES.txt:8-12``; SURVEY.md
+§4 "no helm test hooks"). kvedge-tpu's chart ships a test-hook Pod
+(``helm test <release>``) that runs this module from inside the cluster:
+poll the runtime's ``/healthz`` until it answers 200 (payload check
+passed) or a deadline expires. Polling rather than a single probe
+because ``helm test`` is typically run right after install, while the
+runtime may still be compiling its first payload or waiting for
+multi-host peers — the status server serves 503 until boot completes.
+
+Usable standalone against any deployment:
+
+    python -m kvedge_tpu.runtime.healthcheck http://<ip>:8476/healthz
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def wait_healthy(url: str, deadline_s: float = 240.0,
+                 interval_s: float = 5.0) -> tuple[bool, str]:
+    """Poll ``url`` until HTTP 200 or deadline. Returns (ok, last_detail)."""
+    deadline = time.monotonic() + deadline_s
+    detail = "no attempt made"
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return True, f"HTTP {resp.status}"
+        except urllib.error.HTTPError as e:
+            # 503 = runtime up but degraded/booting; keep polling.
+            detail = f"HTTP {e.code}: {e.read().decode(errors='replace')!r}"
+        except Exception as e:  # DNS not yet registered, conn refused, ...
+            detail = f"{type(e).__name__}: {e}"
+        if time.monotonic() >= deadline:
+            return False, detail
+        time.sleep(min(interval_s, max(0.0, deadline - time.monotonic())))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kvedge-healthcheck",
+        description="Poll a kvedge runtime /healthz until healthy.",
+    )
+    parser.add_argument("url")
+    parser.add_argument("--deadline", type=float, default=240.0,
+                        help="seconds to keep polling (default 240)")
+    parser.add_argument("--interval", type=float, default=5.0,
+                        help="seconds between attempts (default 5)")
+    args = parser.parse_args(argv)
+    ok, detail = wait_healthy(args.url, args.deadline, args.interval)
+    print(f"[kvedge-healthcheck] {args.url}: "
+          f"{'healthy' if ok else 'NOT healthy'} ({detail})", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

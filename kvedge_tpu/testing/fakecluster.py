@@ -71,6 +71,10 @@ class FakeCluster:
         self.deployments: dict[str, dict] = {}
         self.services: dict[str, dict] = {}
         self.pods: dict[str, FakePod] = {}
+        # helm-hook manifests (the chart's `helm test` healthz Pod): real
+        # helm holds these back from install and runs them on demand; the
+        # fake cluster records them without scheduling anything.
+        self.hooks: dict[str, dict] = {}
         self._pod_seq = itertools.count(1)
 
     # ---- admission -------------------------------------------------------
@@ -94,7 +98,11 @@ class FakeCluster:
         for doc in docs:
             kind = doc["kind"]
             name = doc["metadata"]["name"]
-            if kind == "Secret":
+            if "helm.sh/hook" in doc["metadata"].get("annotations", {}):
+                # Checked before kind dispatch: helm holds back ANY
+                # hook-annotated resource from install, whatever its kind.
+                self.hooks[name] = doc
+            elif kind == "Secret":
                 self.secrets[name] = doc
             elif kind == "PersistentVolumeClaim":
                 if name not in self.pvcs:  # keep binding across upgrades

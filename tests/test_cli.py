@@ -9,7 +9,7 @@ def test_render_stdout(capsys):
     assert main(["render"]) == 0
     out = capsys.readouterr()
     docs = list(yaml.safe_load_all(out.out))
-    assert len(docs) == 5
+    assert len(docs) == 6  # incl. the helm-test hook Pod
     assert "You have installed release" in out.err
 
 

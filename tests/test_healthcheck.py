@@ -1,0 +1,83 @@
+"""The helm-test hook payload: poll /healthz until healthy or deadline.
+
+Driven against a real StatusServer (the same server the runtime boots),
+so the hook's contract — 200 passes, 503 keeps polling, recovery within
+the deadline succeeds — is pinned against the actual endpoint behavior.
+"""
+
+import threading
+
+from kvedge_tpu.runtime.healthcheck import main as healthcheck_main
+from kvedge_tpu.runtime.healthcheck import wait_healthy
+from kvedge_tpu.runtime.status import StatusServer
+
+
+def serve(healthy_fn):
+    server = StatusServer(
+        "127.0.0.1", 0, snapshot=lambda: {"ok": healthy_fn()},
+        healthy=healthy_fn,
+    )
+    server.start()
+    return server
+
+
+def test_healthy_immediately():
+    server = serve(lambda: True)
+    try:
+        ok, detail = wait_healthy(
+            f"http://127.0.0.1:{server.port}/healthz", deadline_s=5
+        )
+        assert ok and "200" in detail
+    finally:
+        server.shutdown()
+
+
+def test_degraded_times_out_with_last_error():
+    server = serve(lambda: False)
+    try:
+        ok, detail = wait_healthy(
+            f"http://127.0.0.1:{server.port}/healthz",
+            deadline_s=0.5, interval_s=0.1,
+        )
+        assert not ok
+        assert "503" in detail and "degraded" in detail
+    finally:
+        server.shutdown()
+
+
+def test_recovery_within_deadline_succeeds():
+    # The hook runs right after install while the payload may still be
+    # booting: 503 now, 200 soon — the poll must ride that out.
+    healthy = threading.Event()
+    server = serve(healthy.is_set)
+    try:
+        threading.Timer(0.3, healthy.set).start()
+        ok, _ = wait_healthy(
+            f"http://127.0.0.1:{server.port}/healthz",
+            deadline_s=10, interval_s=0.1,
+        )
+        assert ok
+    finally:
+        server.shutdown()
+
+
+def test_unreachable_endpoint_times_out():
+    # Port 1 on localhost: connection refused, not a hang.
+    ok, detail = wait_healthy(
+        "http://127.0.0.1:1/healthz", deadline_s=0.4, interval_s=0.1
+    )
+    assert not ok and detail
+
+
+def test_cli_exit_codes():
+    server = serve(lambda: True)
+    try:
+        assert healthcheck_main(
+            [f"http://127.0.0.1:{server.port}/healthz", "--deadline", "5"]
+        ) == 0
+    finally:
+        server.shutdown()
+    assert healthcheck_main(
+        ["http://127.0.0.1:1/healthz", "--deadline", "0.3",
+         "--interval", "0.1"]
+    ) == 1

@@ -16,9 +16,11 @@ def _decode(secret, key="userdata"):
     return base64.b64decode(secret["data"][key]).decode("utf-8")
 
 
-def test_default_render_has_five_manifests():
+def test_default_render_manifest_set():
     # Mirrors the reference's rendered set: VM, DataVolume, 2 Secrets,
-    # Service (SURVEY.md §1 L2) — here Deployment, PVC, 2 Secrets, Service.
+    # Service (SURVEY.md §1 L2) — here Deployment, PVC, 2 Secrets, Service,
+    # plus the helm-test hook Pod (an addition; the reference has no test
+    # hooks, SURVEY.md §4).
     chart = render_all(DEFAULT_VALUES)
     assert set(chart.manifests) == {
         "jax-tpu-runtime.yaml",
@@ -26,14 +28,18 @@ def test_default_render_has_five_manifests():
         "jax-tpu-runtime-config-secret.yaml",
         "jax-tpu-boot-config-secret.yaml",
         "jax-tpu-runtime-service.yaml",
+        "jax-tpu-healthz-test.yaml",
     }
 
 
-def test_ssh_gate_drops_service():
+def test_ssh_gate_drops_service_and_test_hook():
     chart = render_all(
         DEFAULT_VALUES.replace(tpuRuntimeEnableExternalSsh=False)
     )
     assert "jax-tpu-runtime-service.yaml" not in chart.manifests
+    # Without the Service there is no stable single-host DNS target for
+    # the hook either.
+    assert "jax-tpu-healthz-test.yaml" not in chart.manifests
     assert len(chart.manifests) == 4
 
 
@@ -123,7 +129,7 @@ def test_yaml_emission_stable_and_parseable():
     chart = render_all(DEFAULT_VALUES)
     stream = to_multidoc_yaml([doc for _, doc in chart.ordered()])
     parsed = list(yaml.safe_load_all(stream))
-    assert len(parsed) == 5
+    assert len(parsed) == 6
     assert to_yaml(chart.manifests["jax-tpu-runtime.yaml"]) == to_yaml(
         chart.manifests["jax-tpu-runtime.yaml"]
     )
@@ -184,6 +190,24 @@ def test_probes_use_version_not_healthz():
 
 
 MULTIHOST_TOML = "[distributed]\nnum_processes = 4\n"
+def test_healthz_test_hook_targets_service_dns():
+    chart = render_all(DEFAULT_VALUES)
+    pod = chart.manifests["jax-tpu-healthz-test.yaml"]
+    assert pod["metadata"]["annotations"]["helm.sh/hook"] == "test"
+    command = pod["spec"]["containers"][0]["command"]
+    assert "http://kvedge-tpu-runtime-ssh-service:8476/healthz" in command
+    assert pod["spec"]["restartPolicy"] == "Never"
+
+
+def test_healthz_test_hook_honors_custom_status_port():
+    chart = render_all(
+        DEFAULT_VALUES.replace(jaxRuntimeConfig="[status]\nport = 9000\n")
+    )
+    command = chart.manifests["jax-tpu-healthz-test.yaml"][
+        "spec"]["containers"][0]["command"]
+    assert "http://kvedge-tpu-runtime-ssh-service:9000/healthz" in command
+
+
 MULTIHOST = DEFAULT_VALUES.replace(tpuNumHosts=4, jaxRuntimeConfig=MULTIHOST_TOML)
 
 
@@ -195,6 +219,7 @@ def test_multihost_render_swaps_workload_and_adds_hosts_service():
         "jax-tpu-runtime-config-secret.yaml",
         "jax-tpu-boot-config-secret.yaml",
         "jax-tpu-runtime-service.yaml",
+        "jax-tpu-healthz-test-multihost.yaml",
     }
     sts = chart.manifests["jax-tpu-runtime-multihost.yaml"]
     assert sts["kind"] == "StatefulSet"
