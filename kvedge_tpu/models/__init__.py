@@ -12,6 +12,7 @@ from kvedge_tpu.models.transformer import (
     TransformerConfig,
     init_params,
     forward,
+    forward_with_aux,
     loss_fn,
     make_train_step,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "TransformerConfig",
     "init_params",
     "forward",
+    "forward_with_aux",
     "loss_fn",
     "make_train_step",
     "KVCache",

@@ -61,6 +61,12 @@ class KVCache:
 def init_cache(cfg: TransformerConfig, batch: int,
                max_seq: int | None = None) -> KVCache:
     cfg.validate()
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "KV-cache decoding does not support MoE configs (n_experts > "
+            "0): the serving path's layer body is dense-FFN only; serve a "
+            "dense config or extend _attend_layer with routed experts"
+        )
     shape = (
         cfg.n_layers, batch, max_seq or cfg.max_seq, cfg.kv_heads, cfg.d_head,
     )

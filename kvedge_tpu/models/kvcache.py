@@ -75,6 +75,11 @@ class PagedKVCache:
     def __init__(self, cfg: TransformerConfig, *, slots: int, pages: int,
                  page_size: int = 16, max_pages_per_seq: int | None = None):
         cfg.validate()
+        if cfg.n_experts:
+            raise NotImplementedError(
+                "paged decoding does not support MoE configs (n_experts > "
+                "0); see models/decode.py:init_cache for the same limit"
+            )
         self.cfg = cfg
         self.slots = slots
         self.page_size = page_size

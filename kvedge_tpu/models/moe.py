@@ -1,0 +1,106 @@
+"""Mixture-of-experts FFN: expert parallelism over an ``expert`` mesh axis.
+
+The third payload scale-out dimension alongside ``model`` (tensor) and
+``seq`` (sequence) — the reference has no parallelism of any kind
+(SURVEY.md §5); this exists because MoE is how a TPU-native payload
+scales parameter count past one chip's HBM without scaling per-token
+FLOPs.
+
+TPU-first design decisions:
+
+* **Switch-style top-1 routing with a static capacity.** Every shape is
+  compile-time constant: each expert processes exactly
+  ``C = ceil(tokens/E * capacity_factor)`` slots, tokens routed past an
+  expert's capacity are *dropped* (their FFN contribution is zero and
+  the residual connection carries them through — the standard Switch
+  Transformer trade that keeps XLA shapes static instead of introducing
+  data-dependent gather/scatter).
+* **Dispatch and combine are einsums with one-hot tensors**, not
+  scatters: ``[N, E, C]`` dispatch against ``[N, D]`` activations gives
+  ``[E, C, D]`` expert inputs on the MXU, and the transpose einsum
+  combines outputs back. XLA partitions these einsums over the mesh.
+* **Sharding is annotation-only**, like the rest of the package: expert
+  weights are stacked on a leading ``E`` axis sharded over the
+  ``expert`` mesh axis (parallel/sharding.py), activations get a
+  ``with_sharding_constraint`` pinning the ``E`` dim of the dispatched
+  block — XLA's SPMD partitioner inserts the all-to-alls. No shard_map.
+* **Router math in fp32** (softmax over expert logits is tiny but
+  numerically load-bearing); expert FFN matmuls in the model's compute
+  dtype (bf16 on TPU).
+
+The router's load-balancing aux loss (Switch eq. 4: ``E * Σ_e f_e·P_e``,
+minimized at 1.0 when routing is uniform) is returned alongside the
+output and folded into the training loss by ``loss_fn`` — without it,
+top-1 routing collapses onto a few experts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def expert_capacity(n_tokens: int, n_experts: int,
+                    capacity_factor: float) -> int:
+    """Per-expert slot count: ceil(tokens/E * factor), at least 1."""
+    import math
+
+    return max(1, math.ceil(n_tokens * capacity_factor / n_experts))
+
+
+def moe_ffn(x, router_w, w_up, w_down, *, capacity_factor: float,
+            mesh=None, expert_axis: str = "expert"):
+    """Top-1 MoE feed-forward. x: [N, D] tokens (any leading flattening).
+
+    router_w: [D, E] fp32; w_up: [E, D, F]; w_down: [E, F, D] (compute
+    dtype). Returns ``(out [N, D], aux_loss scalar fp32)``.
+    """
+    n_tokens, d = x.shape
+    n_experts = router_w.shape[-1]
+    capacity = expert_capacity(n_tokens, n_experts, capacity_factor)
+
+    # Routing in fp32.
+    router_logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(router_logits, axis=-1)          # [N, E]
+    expert_index = jnp.argmax(probs, axis=-1)               # [N]
+    onehot = jax.nn.one_hot(expert_index, n_experts,
+                            dtype=jnp.float32)              # [N, E]
+    gate = jnp.sum(probs * onehot, axis=-1)                 # [N]
+
+    # Position of each token within its expert's capacity buffer; tokens
+    # past capacity get dropped (mask -> 0) — shapes stay static.
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # [N, E]
+    within = (position < capacity) & (position >= 0)
+    dispatch = jnp.where(within, onehot, 0.0)               # [N, E]
+    # Each kept token's slot index: position at its expert's column
+    # (dispatch is the mask, so dropped tokens contribute a zero row in
+    # dispatch_ohc regardless of the slot value picked here).
+    slot_index = jnp.sum(position * dispatch, axis=-1).astype(jnp.int32)
+    slot = jax.nn.one_hot(slot_index, capacity, dtype=jnp.float32)  # [N, C]
+    dispatch_ohc = dispatch[:, :, None] * slot[:, None, :]  # [N, E, C]
+
+    # Aux load-balancing loss over the *pre-capacity* routing decision
+    # (Switch Transformer eq. 4): minimized at 1.0 for uniform routing.
+    fraction = jnp.mean(onehot, axis=0)                     # [E]
+    mean_prob = jnp.mean(probs, axis=0)                     # [E]
+    aux_loss = n_experts * jnp.sum(fraction * mean_prob)
+
+    dtype = x.dtype
+    expert_in = jnp.einsum(
+        "nec,nd->ecd", dispatch_ohc.astype(dtype), x
+    )                                                        # [E, C, D]
+    if mesh is not None and expert_axis in mesh.axis_names:
+        constrain = NamedSharding(mesh, P(expert_axis, None, None))
+        expert_in = lax.with_sharding_constraint(expert_in, constrain)
+    hidden = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(dtype))
+    )
+    expert_out = jnp.einsum("ecf,efd->ecd", hidden, w_down.astype(dtype))
+    if mesh is not None and expert_axis in mesh.axis_names:
+        expert_out = lax.with_sharding_constraint(expert_out, constrain)
+
+    combine = (dispatch_ohc * gate[:, None, None]).astype(dtype)
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out)    # [N, D]
+    return out, aux_loss

@@ -41,6 +41,19 @@ class TransformerConfig:
     # (notably the [T, T] attention scores, which otherwise live for every
     # layer at once under lax.scan) — the standard HBM-for-FLOPs trade.
     remat: bool = True
+    # Mixture-of-experts FFN (models/moe.py): 0 = dense. With n_experts
+    # set, every layer's FFN becomes E switch-routed experts whose
+    # stacked weights shard over an ``expert`` mesh axis — parameter
+    # scale-out without per-token FLOP growth. Training-path only: the
+    # decode/serving paths (models/decode.py, models/kvcache.py) reject
+    # MoE configs explicitly.
+    n_experts: int = 0
+    # Per-expert slot headroom: capacity = ceil(tokens/E * factor);
+    # tokens routed past capacity are dropped (residual carries them).
+    expert_capacity_factor: float = 1.25
+    # Weight of the router's load-balancing aux loss in the training
+    # loss (Switch Transformer uses 1e-2).
+    moe_aux_weight: float = 0.01
     # "naive" materializes [T, T] scores (XLA-fused); "flash" streams K/V
     # blocks through a Pallas kernel with an online softmax (no [T, T] in
     # forward); "ring" shards the sequence over the mesh's ``seq`` axis
@@ -71,6 +84,10 @@ class TransformerConfig:
                 "attention must be 'naive', 'flash', 'ring', or "
                 f"'ulysses', got {self.attention!r}"
             )
+        if self.n_experts < 0:
+            raise ValueError("n_experts must be >= 0 (0 = dense FFN)")
+        if self.n_experts and self.expert_capacity_factor <= 0:
+            raise ValueError("expert_capacity_factor must be > 0")
 
 
 def init_params(key, cfg: TransformerConfig) -> dict:
@@ -85,18 +102,28 @@ def init_params(key, cfg: TransformerConfig) -> dict:
     def normal(k, shape, scale):
         return (jax.random.normal(k, shape, jnp.float32) * scale)
 
-    return {
+    params = {
         "embedding": normal(k_embed, (cfg.vocab, d), 0.02),
         # Fused projection: [q | k | v] along the output dim; k/v carry
         # cfg.kv_heads heads (== n_heads unless GQA is on).
         "w_qkv": normal(k_qkv, (layers, d, (h + 2 * kv) * dh), d ** -0.5),
         "w_out": normal(k_out, (layers, h * dh, d), (h * dh) ** -0.5),
-        "w_up": normal(k_up, (layers, d, f), d ** -0.5),
-        "w_down": normal(k_down, (layers, f, d), f ** -0.5),
         "ln_attn": jnp.ones((layers, d), jnp.float32),
         "ln_mlp": jnp.ones((layers, d), jnp.float32),
         "ln_final": jnp.ones((d,), jnp.float32),
     }
+    if cfg.n_experts:
+        e = cfg.n_experts
+        k_router = jax.random.fold_in(k_up, 1)
+        params["router"] = normal(k_router, (layers, d, e), d ** -0.5)
+        params["w_up_experts"] = normal(k_up, (layers, e, d, f), d ** -0.5)
+        params["w_down_experts"] = normal(
+            k_down, (layers, e, f, d), f ** -0.5
+        )
+    else:
+        params["w_up"] = normal(k_up, (layers, d, f), d ** -0.5)
+        params["w_down"] = normal(k_down, (layers, f, d), f ** -0.5)
+    return params
 
 
 def tied_readout(x, embedding):
@@ -154,8 +181,15 @@ def split_qkv(cfg: TransformerConfig, qkv):
 
 
 def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
-    """One pre-norm decoder block. x: [B, T, D] in compute dtype."""
-    w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
+    """One pre-norm decoder block. x: [B, T, D] in compute dtype.
+
+    Returns ``(x, aux)`` — ``aux`` is the MoE router's load-balancing
+    loss for this layer (0.0 for a dense FFN).
+    """
+    if cfg.n_experts:
+        w_qkv, w_out, router, w_up, w_down, ln_attn, ln_mlp = layer_params
+    else:
+        w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
     batch, seq, d = x.shape
     h, kv, dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
     dtype = x.dtype
@@ -216,17 +250,30 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
         attended = attended.reshape(batch, seq, h * dh)
     x = x + attended @ w_out.astype(dtype)
 
-    # MLP.
+    # MLP — dense, or switch-routed experts (models/moe.py).
     normed = _rmsnorm(x, ln_mlp)
-    up = normed @ w_up.astype(dtype)
-    x = x + jax.nn.gelu(up) @ w_down.astype(dtype)
-    return x
+    if cfg.n_experts:
+        from kvedge_tpu.models.moe import moe_ffn
+
+        out, aux = moe_ffn(
+            normed.reshape(batch * seq, d), router, w_up, w_down,
+            capacity_factor=cfg.expert_capacity_factor, mesh=mesh,
+        )
+        x = x + out.reshape(batch, seq, d)
+    else:
+        up = normed @ w_up.astype(dtype)
+        x = x + jax.nn.gelu(up) @ w_down.astype(dtype)
+        aux = jnp.zeros((), jnp.float32)
+    return x, aux
 
 
-def forward(params: dict, tokens, cfg: TransformerConfig, mesh=None):
-    """tokens [B, T] int32 -> logits [B, T, V] (fp32).
+def forward_with_aux(params: dict, tokens, cfg: TransformerConfig,
+                     mesh=None):
+    """tokens [B, T] int32 -> (logits [B, T, V] fp32, aux scalar fp32).
 
-    ``mesh`` is only needed for the sequence-parallel attention modes
+    ``aux`` is the mean per-layer MoE load-balancing loss (0.0 for dense
+    configs); ``loss_fn`` folds it into the training objective. ``mesh``
+    is only needed for the sequence-parallel attention modes
     (``'ring'``/``'ulysses'``); when given, activations are pinned
     seq-sharded between layers so the LN/MLP work stays sequence-parallel
     too.
@@ -246,29 +293,46 @@ def forward(params: dict, tokens, cfg: TransformerConfig, mesh=None):
 
         x = constrain(x)
 
-    stacked = (
-        params["w_qkv"], params["w_out"], params["w_up"], params["w_down"],
-        params["ln_attn"], params["ln_mlp"],
-    )
+    if cfg.n_experts:
+        stacked = (
+            params["w_qkv"], params["w_out"], params["router"],
+            params["w_up_experts"], params["w_down_experts"],
+            params["ln_attn"], params["ln_mlp"],
+        )
+    else:
+        stacked = (
+            params["w_qkv"], params["w_out"], params["w_up"],
+            params["w_down"], params["ln_attn"], params["ln_mlp"],
+        )
 
     def body(carry, layer_params):
-        out = _layer(cfg, carry, layer_params, mesh)
+        out, aux = _layer(cfg, carry, layer_params, mesh)
         if constrain is not None:
             out = constrain(out)
-        return out, None
+        return out, aux
 
     if cfg.remat:
         body = jax.checkpoint(body)
-    x, _ = lax.scan(body, x, stacked)
+    x, aux_per_layer = lax.scan(body, x, stacked)
     x = _rmsnorm(x, params["ln_final"])
-    return tied_readout(x, embedding)
+    return tied_readout(x, embedding), jnp.mean(aux_per_layer)
+
+
+def forward(params: dict, tokens, cfg: TransformerConfig, mesh=None):
+    """tokens [B, T] int32 -> logits [B, T, V] (fp32).
+
+    See :func:`forward_with_aux` for the mesh semantics; this wrapper
+    drops the MoE aux loss for callers that only want logits.
+    """
+    logits, _ = forward_with_aux(params, tokens, cfg, mesh)
+    return logits
 
 
 def loss_fn(params: dict, batch, cfg: TransformerConfig, mesh=None):
     """Next-token cross-entropy. batch [B, T] int32; targets are shifted."""
     inputs = batch[:, :-1]
     targets = batch[:, 1:]
-    logits = forward(params, inputs, cfg, mesh)
+    logits, aux = forward_with_aux(params, inputs, cfg, mesh)
     # Fused cross-entropy: logsumexp(logits) - logits[target] needs only
     # two [B, T] reductions over the vocab axis, instead of materializing a
     # second [B, T, V] fp32 log-probs tensor (which at vocab=32000 would be
@@ -276,7 +340,12 @@ def loss_fn(params: dict, batch, cfg: TransformerConfig, mesh=None):
     target_logit = jnp.take_along_axis(
         logits, targets[..., None], axis=-1
     )[..., 0]
-    return jnp.mean(jax.nn.logsumexp(logits, axis=-1) - target_logit)
+    ce = jnp.mean(jax.nn.logsumexp(logits, axis=-1) - target_logit)
+    if cfg.n_experts:
+        # Router load balancing: without it, top-1 routing collapses onto
+        # a few experts and the rest never train.
+        ce = ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 def make_train_step(cfg: TransformerConfig, optimizer=None, mesh=None):

@@ -31,6 +31,13 @@ PARAM_RULES: dict[str, P] = {
     "ln_attn": P(),                       # [L, D] — replicated
     "ln_mlp": P(),                        # [L, D]
     "ln_final": P(),                      # [D]
+    # Mixture-of-experts (models/moe.py): the stacked expert dim shards
+    # over the ``expert`` axis — each device holds E/ep experts whole;
+    # within an expert the FFN is still Megatron column/row-parallel on
+    # ``model``, composing ep×tp on one mesh.
+    "router": P(),                        # [L, D, E] — replicated
+    "w_up_experts": P(None, "expert", None, "model"),    # [L, E, D, F]
+    "w_down_experts": P(None, "expert", "model", None),  # [L, E, F, D]
 }
 
 

@@ -63,6 +63,10 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
         # Ulysses scatters heads over the seq axis: round up to the next
         # multiple of sp.
         n_heads = sp * -(-n_heads // sp)
+    # An ``expert`` axis in the operator's mesh turns the probe's FFN
+    # into a mixture of experts sharded over it — the probe then
+    # exercises expert-parallel dispatch/combine too.
+    n_experts = axis_sizes.get("expert", 1)
     try:
         # Inside the try: an sp-derived head count can make the model
         # config itself invalid (d_model % n_heads), and that must surface
@@ -75,11 +79,17 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
             d_ff=4 * PROBE_D_MODEL,
             max_seq=PROBE_SEQ,
             attention=attention,
+            n_experts=n_experts if n_experts > 1 else 0,
         )
         key = jax.random.PRNGKey(0)
         params = shard_params(mesh, init_params(key, tcfg))
+        # The mesh reaches the model whenever a strategy needs it at
+        # trace time: sequence-parallel shard_maps AND the MoE layer's
+        # with_sharding_constraint (which pins expert-parallel
+        # dispatch/combine — without it XLA may replicate the experts).
+        needs_mesh = sequence_parallel or tcfg.n_experts > 0
         init_opt, train_step = make_train_step(
-            tcfg, mesh=mesh if sequence_parallel else None
+            tcfg, mesh=mesh if needs_mesh else None
         )
         opt_state = init_opt(params)
         batch = shard_batch(

@@ -275,6 +275,21 @@ def test_transformer_probe_ring_on_seq_mesh(tmp_path):
     assert math.isfinite(result.probe_checksum)
 
 
+def test_transformer_probe_moe_on_expert_mesh(tmp_path):
+    """An `expert` axis in the operator's mesh routes the probe through
+    the mixture-of-experts FFN (expert parallelism)."""
+    import math
+
+    from kvedge_tpu.config.runtime_config import MeshSpec
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    cfg = _cfg(tmp_path, mesh=MeshSpec(axes=(("data", 2), ("expert", 4))))
+    result = run_transformer_probe(cfg)
+    assert result.ok, result.error
+    assert result.mesh_shape == (2, 4)
+    assert math.isfinite(result.probe_checksum)
+
+
 def test_transformer_probe_ulysses_via_config(tmp_path):
     """[payload] attention = 'ulysses' selects the all-to-all strategy."""
     import math
