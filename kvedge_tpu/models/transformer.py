@@ -54,6 +54,16 @@ class TransformerConfig:
     # Weight of the router's load-balancing aux loss in the training
     # loss (Switch Transformer uses 1e-2).
     moe_aux_weight: float = 0.01
+    # Pipeline parallelism (parallel/pipeline.py): 0 = off. With S > 1
+    # the layer-stacked params shard their leading L axis over a
+    # ``stage`` mesh axis (L/S whole layers per device) and forward runs
+    # a GPipe microbatch schedule with ppermute stage hand-offs.
+    # Requires a mesh with a ``stage`` axis; currently dense-FFN +
+    # local-attention configs only.
+    pipeline_stages: int = 0
+    # Microbatches per step under pipelining; 0 = one per stage. More
+    # microbatches shrink the pipeline bubble (M / (M + S - 1)).
+    pipeline_microbatches: int = 0
     # "naive" materializes [T, T] scores (XLA-fused); "flash" streams K/V
     # blocks through a Pallas kernel with an online softmax (no [T, T] in
     # forward); "ring" shards the sequence over the mesh's ``seq`` axis
@@ -69,6 +79,16 @@ class TransformerConfig:
     def d_head(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def needs_mesh(self) -> bool:
+        """True when the concrete mesh is required at trace time: the
+        sequence-parallel and pipeline shard_maps, and the MoE layer's
+        expert-placement ``with_sharding_constraint`` (without which XLA
+        may replicate the experts). Callers pass ``mesh`` to
+        :func:`forward`/:func:`make_train_step` iff this is set."""
+        return (self.attention in ("ring", "ulysses")
+                or self.n_experts > 0 or self.pipeline_stages > 1)
 
     @property
     def kv_heads(self) -> int:
@@ -88,6 +108,28 @@ class TransformerConfig:
             raise ValueError("n_experts must be >= 0 (0 = dense FFN)")
         if self.n_experts and self.expert_capacity_factor <= 0:
             raise ValueError("expert_capacity_factor must be > 0")
+        if self.pipeline_stages < 0:
+            raise ValueError("pipeline_stages must be >= 0 (0 = off)")
+        if self.pipeline_microbatches < 0:
+            raise ValueError(
+                "pipeline_microbatches must be >= 0 (0 = one per stage)"
+            )
+        if self.pipeline_stages > 1:
+            if self.n_layers % self.pipeline_stages:
+                raise ValueError(
+                    f"n_layers {self.n_layers} must divide by "
+                    f"pipeline_stages {self.pipeline_stages}"
+                )
+            if self.attention in ("ring", "ulysses"):
+                raise ValueError(
+                    "pipeline parallelism does not compose with "
+                    "sequence-parallel attention yet (their collectives "
+                    "would nest inside the stage-local layer body)"
+                )
+            if self.n_experts:
+                raise ValueError(
+                    "pipeline parallelism does not compose with MoE yet"
+                )
 
 
 def init_params(key, cfg: TransformerConfig) -> dict:
@@ -304,6 +346,24 @@ def forward_with_aux(params: dict, tokens, cfg: TransformerConfig,
             params["w_qkv"], params["w_out"], params["w_up"],
             params["w_down"], params["ln_attn"], params["ln_mlp"],
         )
+
+    if cfg.pipeline_stages > 1:
+        if mesh is None:
+            raise ValueError(
+                "pipeline_stages > 1 needs a mesh with a 'stage' axis "
+                "passed to forward()/make_train_step()"
+            )
+        from kvedge_tpu.parallel.pipeline import pipeline_layers
+
+        x = pipeline_layers(
+            x, stacked,
+            lambda carry, lp: _layer(cfg, carry, lp, None)[0],
+            mesh, n_layers=cfg.n_layers,
+            n_microbatches=cfg.pipeline_microbatches, remat=cfg.remat,
+        )
+        aux = jnp.zeros((), jnp.float32)  # pipeline excludes MoE (validate)
+        x = _rmsnorm(x, params["ln_final"])
+        return tied_readout(x, embedding), aux
 
     def body(carry, layer_params):
         out, aux = _layer(cfg, carry, layer_params, mesh)

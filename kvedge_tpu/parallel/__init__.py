@@ -9,6 +9,7 @@ communication.
 """
 
 from kvedge_tpu.parallel.mesh import build_mesh, local_mesh
+from kvedge_tpu.parallel.pipeline import pipeline_layers
 from kvedge_tpu.parallel.ringattention import ring_attention, sequence_sharding
 from kvedge_tpu.parallel.ulysses import ulysses_attention
 from kvedge_tpu.parallel.sharding import (
@@ -23,6 +24,7 @@ __all__ = [
     "local_mesh",
     "batch_spec",
     "param_specs",
+    "pipeline_layers",
     "ring_attention",
     "sequence_sharding",
     "shard_params",

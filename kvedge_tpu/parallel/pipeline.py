@@ -1,0 +1,153 @@
+"""Pipeline parallelism: the layer stack sharded over a ``stage`` mesh axis.
+
+The fourth scale-out dimension (after ``data``, ``model``, ``seq``,
+``expert`` — the reference has no parallelism of any kind, SURVEY.md §5):
+for models too deep for one chip even with tensor/expert sharding, the
+layer-stacked parameter arrays shard their leading ``L`` axis over
+``stage`` — each device holds ``L/S`` whole layers — and activations flow
+stage-to-stage through a GPipe-style microbatch schedule.
+
+TPU-first design:
+
+* **The layer axis is already stacked** for ``lax.scan`` (one compiled
+  layer body), so pipelining is just *sharding that axis*: in_specs put
+  ``P('stage')`` on dim 0 of every stacked param and each device scans
+  its local ``L/S`` slice. No per-stage module surgery.
+* **Stage hand-off is one ``ppermute`` hop per schedule step** — neighbor
+  traffic that rides ICI, exactly like ring attention's K/V rotation.
+* **The schedule is a ``lax.scan`` over ``M + S - 1`` steps** (M
+  microbatches, S stages): static trip count, no data-dependent control
+  flow. During fill/drain, off-schedule devices compute on garbage —
+  the standard SPMD pipeline bubble; wall-clock efficiency is
+  ``M / (M + S - 1)``, so more microbatches amortize it.
+* **Differentiable end-to-end**: ppermute's transpose is the reverse
+  permutation and the final psum's is a broadcast, so ``jax.grad``
+  through the whole schedule yields the 1F1B-equivalent backward without
+  hand-written stage logic.
+
+Composes with ``data`` parallelism (microbatches shard their batch dim on
+``data``; the two axes are orthogonal). Sequence-parallel attention and
+MoE layers are rejected for now — their collectives would have to nest
+inside the stage-local layer body (future work, README).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _stage_specs(n_arrays: int, data_axis: str | None):
+    """in_specs: activations [M, mb, T, D] + n stacked params [L, ...]."""
+    act = P(None, data_axis, None, None)
+    return (act, *([P("stage")] * n_arrays))
+
+
+def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
+                    stage_axis: str = "stage", data_axis: str = "data",
+                    n_microbatches: int = 0, remat: bool = True):
+    """Run ``n_layers`` stacked layers over ``x``, pipelined over stages.
+
+    x: [B, T, D] (compute dtype); ``stacked``: tuple of layer-stacked
+    arrays, each [L, ...]; ``layer_fn(carry, layer_params) -> carry`` is
+    the single-layer body (already closed over the config). Returns
+    [B, T, D].
+    """
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if stage_axis not in axis_sizes:
+        raise ValueError(
+            f"mesh has no {stage_axis!r} axis (axes: {sorted(axis_sizes)}) "
+            "— pipeline parallelism needs a stage axis"
+        )
+    if "model" in axis_sizes and axis_sizes["model"] > 1:
+        # The shard_map's in_specs name only the stage/data axes, so a
+        # model axis would silently all-gather the tensor-parallel dims
+        # of every stacked param onto each device — refuse rather than
+        # quietly replicate (pp×tp composition is future work, README).
+        raise ValueError(
+            "pipeline parallelism does not compose with a 'model' "
+            "(tensor-parallel) mesh axis yet"
+        )
+    stages = axis_sizes[stage_axis]
+    if n_layers % stages:
+        raise ValueError(
+            f"n_layers {n_layers} must divide by the {stage_axis!r} axis "
+            f"size {stages} (whole layers per stage)"
+        )
+    batch = x.shape[0]
+    micro = n_microbatches or stages
+    if batch % micro:
+        raise ValueError(
+            f"batch {batch} must divide into {micro} microbatches"
+        )
+    dspec = data_axis if data_axis in axis_sizes else None
+    if dspec and (batch // micro) % axis_sizes[data_axis]:
+        raise ValueError(
+            f"microbatch size {batch // micro} (batch {batch} / {micro} "
+            f"microbatches) must divide by the {data_axis!r} axis size "
+            f"{axis_sizes[data_axis]}"
+        )
+
+    x_mb = x.reshape(micro, batch // micro, *x.shape[1:])  # [M, mb, T, D]
+
+    def local_fn(x_local, *stacked_local):
+        # x_local: [M, mb_local, T, D]; stacked_local: [L/S, ...] each.
+        stage = lax.axis_index(stage_axis)
+        steps = micro + stages - 1
+        forward_hop = [(i, i + 1) for i in range(stages - 1)]
+
+        def apply_local_layers(h):
+            body_fn = layer_fn
+            if remat:
+                body_fn = jax.checkpoint(body_fn)
+            h, _ = lax.scan(
+                lambda carry, lp: (body_fn(carry, lp), None),
+                h, stacked_local,
+            )
+            return h
+
+        # Initial carries must already vary over the stage axis: the loop
+        # body mixes in stage-dependent values (axis_index, ppermute), and
+        # scan requires carry-in/carry-out types — including varying
+        # manual axes — to match (same trick as ringattention.py's
+        # initializers).
+        zero_stage = stage.astype(x_local.dtype) * 0.0
+        state0 = x_local[0] * 0.0 + zero_stage
+        outputs0 = x_local * 0.0 + zero_stage
+
+        def step_fn(carry, step):
+            state, outputs = carry
+            # Stage 0 feeds microbatch `step` during the fill phase;
+            # later stages consume what the previous stage sent.
+            feed = x_local[jnp.clip(step, 0, micro - 1)]
+            h = jnp.where(stage == 0, feed, state)
+            h = apply_local_layers(h)
+            # The last stage finishes microbatch `step - (S-1)`.
+            out_idx = step - (stages - 1)
+            finished = (stage == stages - 1) & (out_idx >= 0)
+            outputs = jnp.where(
+                finished,
+                outputs.at[jnp.clip(out_idx, 0, micro - 1)].set(h),
+                outputs,
+            )
+            state = lax.ppermute(h, stage_axis, forward_hop)
+            return (state, outputs), None
+
+        (_, outputs), _ = lax.scan(
+            step_fn, (state0, outputs0), jnp.arange(steps)
+        )
+        # Only the last stage holds real outputs; zero elsewhere, so one
+        # psum over the stage axis replicates them to every stage (its
+        # transpose under grad is a cheap broadcast).
+        outputs = jnp.where(stage == stages - 1, outputs, 0.0)
+        return lax.psum(outputs, stage_axis)
+
+    out = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=_stage_specs(len(stacked), dspec),
+        out_specs=P(None, dspec, None, None),
+    )(x_mb, *stacked)
+    return out.reshape(batch, *x.shape[1:])

@@ -20,8 +20,9 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 # Rules keyed by parameter name (the flagship model's param pytree keys).
-# Layer-stacked params carry a leading layer axis (for lax.scan), which is
-# never sharded.
+# Layer-stacked params carry a leading layer axis (for lax.scan) that is
+# replicated — except under pipeline parallelism, where a ``stage`` mesh
+# axis shards it (L/S whole layers per device; see param_specs).
 PARAM_RULES: dict[str, P] = {
     "embedding": P(None, "model"),        # [V, D] — feature-sharded
     "w_qkv": P(None, None, "model"),      # [L, D, (H+2K)*Dh] — column-parallel
@@ -50,16 +51,31 @@ def _prune(spec: P, mesh) -> P:
     return P(*(axis if axis in names else None for axis in spec))
 
 
+# Params whose leading dim is the layer-stack axis (shardable on `stage`).
+_LAYER_STACKED = frozenset({
+    "w_qkv", "w_out", "w_up", "w_down", "ln_attn", "ln_mlp",
+    "router", "w_up_experts", "w_down_experts",
+})
+
+
 def param_specs(params: dict, mesh=None) -> dict:
     """PartitionSpec tree matching a flagship param tree.
 
     With ``mesh``, rules referencing axes the mesh lacks degrade to
-    replicated on those dims.
+    replicated on those dims; a ``stage`` axis in the mesh (pipeline
+    parallelism) shards every layer-stacked param's leading L axis.
     """
     missing = set(params) - set(PARAM_RULES)
     if missing:
         raise ValueError(f"no partition rule for params: {sorted(missing)}")
-    return {name: _prune(PARAM_RULES[name], mesh) for name in params}
+    stage = mesh is not None and "stage" in mesh.axis_names
+    specs = {}
+    for name in params:
+        spec = _prune(PARAM_RULES[name], mesh)
+        if stage and name in _LAYER_STACKED:
+            spec = P("stage", *spec[1:])
+        specs[name] = spec
+    return specs
 
 
 def batch_spec(mesh=None) -> P:

@@ -67,29 +67,43 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
     # into a mixture of experts sharded over it — the probe then
     # exercises expert-parallel dispatch/combine too.
     n_experts = axis_sizes.get("expert", 1)
+    # A ``stage`` axis pipelines the probe's layer stack (GPipe schedule
+    # with ppermute hand-offs). Probe layers scale to one per stage.
+    stages = axis_sizes.get("stage", 1)
+    if stages > 1 and (sp > 1 or n_experts > 1 or model_axis > 1):
+        # A healthy runtime with an un-runnable mesh combination: surface
+        # a clear config message, not a generic "probe failed" traceback.
+        return dataclasses.replace(
+            base, ok=False,
+            error=(
+                "mesh combines 'stage' with "
+                "'seq'/'expert'/'model' — pipeline parallelism does not "
+                "compose with sequence/expert/tensor parallelism yet "
+                "(README future work); use one scale-out family per mesh"
+            ),
+        )
     try:
         # Inside the try: an sp-derived head count can make the model
         # config itself invalid (d_model % n_heads), and that must surface
         # as a structured probe failure like every other error here.
+        n_layers = PROBE_LAYERS
+        if stages > 1 and n_layers % stages:
+            n_layers = stages  # one layer per stage
         tcfg = TransformerConfig(
             vocab=PROBE_VOCAB,
             d_model=PROBE_D_MODEL,
             n_heads=n_heads,
-            n_layers=PROBE_LAYERS,
+            n_layers=n_layers,
             d_ff=4 * PROBE_D_MODEL,
             max_seq=PROBE_SEQ,
             attention=attention,
             n_experts=n_experts if n_experts > 1 else 0,
+            pipeline_stages=stages if stages > 1 else 0,
         )
         key = jax.random.PRNGKey(0)
         params = shard_params(mesh, init_params(key, tcfg))
-        # The mesh reaches the model whenever a strategy needs it at
-        # trace time: sequence-parallel shard_maps AND the MoE layer's
-        # with_sharding_constraint (which pins expert-parallel
-        # dispatch/combine — without it XLA may replicate the experts).
-        needs_mesh = sequence_parallel or tcfg.n_experts > 0
         init_opt, train_step = make_train_step(
-            tcfg, mesh=mesh if needs_mesh else None
+            tcfg, mesh=mesh if tcfg.needs_mesh else None
         )
         opt_state = init_opt(params)
         batch = shard_batch(

@@ -1,0 +1,198 @@
+"""Pipeline parallelism (stage axis) vs the plain layer scan.
+
+Runs on the 8-virtual-CPU-device mesh from conftest. Property under
+test: sharding the layer stack over a ``stage`` mesh axis and running
+the GPipe microbatch schedule (ppermute hand-offs, fill/drain bubble)
+is *numerically* the same network — forward and gradients — as the
+single-device ``lax.scan`` over all layers.
+
+(The reference repo has no parallelism of any kind — SURVEY.md §5.)
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kvedge_tpu.config.runtime_config import MeshSpec
+from kvedge_tpu.models import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+)
+from kvedge_tpu.parallel import build_mesh, shard_batch, shard_params
+
+PP_CFG = TransformerConfig(
+    vocab=128, d_model=32, n_heads=4, n_layers=4, d_ff=64, max_seq=64,
+    dtype="float32", pipeline_stages=4,
+)
+DENSE_CFG = dataclasses.replace(PP_CFG, pipeline_stages=0)
+
+
+def pp_mesh(stages=4, data=2):
+    return build_mesh(
+        MeshSpec(axes=(("data", data), ("stage", stages))),
+        devices=jax.devices()[: data * stages],
+    )
+
+
+def test_pipeline_forward_matches_plain_scan():
+    mesh = pp_mesh()
+    params = init_params(jax.random.PRNGKey(0), PP_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+    got = forward(params, tokens, PP_CFG, mesh)
+    want = forward(params, tokens, DENSE_CFG)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-4)
+
+
+def test_pipeline_more_microbatches_than_stages():
+    cfg = dataclasses.replace(PP_CFG, pipeline_microbatches=8)
+    mesh = pp_mesh()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (16, 32), 0, 128)
+    got = forward(params, tokens, cfg, mesh)
+    want = forward(params, tokens, DENSE_CFG)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-4)
+
+
+def test_pipeline_rejects_microbatch_smaller_than_data_axis():
+    cfg = dataclasses.replace(PP_CFG, pipeline_microbatches=8)
+    mesh = pp_mesh()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((8, 16), jnp.int32)  # mb=1 cannot shard over data=2
+    with pytest.raises(ValueError, match="data"):
+        forward(params, tokens, cfg, mesh)
+
+
+def test_pipeline_gradients_match_plain_scan():
+    mesh = pp_mesh(stages=2, data=1)
+    cfg = dataclasses.replace(PP_CFG, n_layers=2, pipeline_stages=2)
+    dense = dataclasses.replace(cfg, pipeline_stages=0)
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    batch = jax.random.randint(jax.random.PRNGKey(3), (4, 33), 0, 128)
+
+    got = jax.grad(loss_fn)(params, batch, cfg, mesh)
+    want = jax.grad(loss_fn)(params, batch, dense)
+    for name in want:
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]), atol=2e-4,
+            err_msg=f"grad mismatch in {name}",
+        )
+
+
+def test_pipeline_train_step_runs_and_learns():
+    mesh = pp_mesh()
+    params = shard_params(mesh, init_params(jax.random.PRNGKey(0), PP_CFG))
+    init_opt, train_step = make_train_step(PP_CFG, mesh=mesh)
+    opt_state = init_opt(params)
+    batch = shard_batch(
+        mesh,
+        jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                           PP_CFG.vocab, dtype=jnp.int32),
+    )
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_stage_axis_shards_layer_stack():
+    from kvedge_tpu.parallel.sharding import param_specs
+
+    mesh = pp_mesh()
+    params = init_params(jax.random.PRNGKey(0), PP_CFG)
+    specs = param_specs(params, mesh)
+    assert specs["w_qkv"][0] == "stage"
+    assert specs["ln_attn"][0] == "stage"
+    assert specs["embedding"] != ("stage",)  # not layer-stacked
+
+
+def test_pipeline_requires_mesh():
+    params = init_params(jax.random.PRNGKey(0), PP_CFG)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    with pytest.raises(ValueError, match="stage"):
+        forward(params, tokens, PP_CFG)
+
+
+def test_pipeline_rejects_mesh_without_stage_axis():
+    mesh = build_mesh(MeshSpec(axes=(("data", 4), ("model", 2))))
+    params = init_params(jax.random.PRNGKey(0), PP_CFG)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    with pytest.raises(ValueError, match="stage"):
+        forward(params, tokens, PP_CFG, mesh)
+
+
+def test_pipeline_rejects_indivisible_batch():
+    mesh = pp_mesh()
+    params = init_params(jax.random.PRNGKey(0), PP_CFG)
+    tokens = jnp.zeros((3, 16), jnp.int32)  # 3 % 4 microbatches != 0
+    with pytest.raises(ValueError, match="microbatch"):
+        forward(params, tokens, PP_CFG, mesh)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="divide"):
+        dataclasses.replace(PP_CFG, n_layers=3).validate()
+    with pytest.raises(ValueError, match="sequence-parallel"):
+        dataclasses.replace(PP_CFG, attention="ring").validate()
+    with pytest.raises(ValueError, match="MoE"):
+        dataclasses.replace(PP_CFG, n_experts=2).validate()
+    with pytest.raises(ValueError, match="microbatches"):
+        dataclasses.replace(PP_CFG, pipeline_microbatches=-2).validate()
+
+
+def test_pipeline_rejects_model_axis_mesh():
+    # pp×tp composition is future work: the shard_map would silently
+    # all-gather the tensor-parallel dims, so it must refuse instead.
+    mesh = build_mesh(
+        MeshSpec(axes=(("data", 1), ("stage", 4), ("model", 2)))
+    )
+    params = init_params(jax.random.PRNGKey(0), PP_CFG)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    with pytest.raises(ValueError, match="model"):
+        forward(params, tokens, PP_CFG, mesh)
+
+
+def test_probe_reports_clear_error_for_stage_plus_seq_mesh(tmp_path):
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    cfg = dataclasses.replace(
+        RuntimeConfig(),
+        name="pp-conflict",
+        state_dir=str(tmp_path / "state"),
+        expected_platform="cpu",
+        status_port=0,
+        status_bind="127.0.0.1",
+        mesh=MeshSpec(axes=(("seq", 2), ("stage", 4))),
+    )
+    result = run_transformer_probe(cfg)
+    assert not result.ok
+    assert "does not compose" in result.error
+
+
+def test_transformer_probe_pipeline_on_stage_mesh(tmp_path):
+    import math
+
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    cfg = dataclasses.replace(
+        RuntimeConfig(),
+        name="pp-probe",
+        state_dir=str(tmp_path / "state"),
+        expected_platform="cpu",
+        status_port=0,
+        status_bind="127.0.0.1",
+        mesh=MeshSpec(axes=(("data", 2), ("stage", 4))),
+    )
+    result = run_transformer_probe(cfg)
+    assert result.ok, result.error
+    assert result.mesh_shape == (2, 4)
+    assert math.isfinite(result.probe_checksum)
