@@ -36,6 +36,7 @@ from kvedge_tpu.models.transformer import (
     _rmsnorm,
     _rotary,
     split_qkv,
+    stacked_layer_params,
     tied_readout,
 )
 
@@ -61,12 +62,6 @@ class KVCache:
 def init_cache(cfg: TransformerConfig, batch: int,
                max_seq: int | None = None) -> KVCache:
     cfg.validate()
-    if cfg.n_experts:
-        raise NotImplementedError(
-            "KV-cache decoding does not support MoE configs (n_experts > "
-            "0): the serving path's layer body is dense-FFN only; serve a "
-            "dense config or extend _attend_layer with routed experts"
-        )
     shape = (
         cfg.n_layers, batch, max_seq or cfg.max_seq, cfg.kv_heads, cfg.d_head,
     )
@@ -87,7 +82,10 @@ def _attend_layer(cfg: TransformerConfig, x, layer_params, k_slab, v_slab,
     new positions written in. Works for prefill (Q = prompt len, pos = 0)
     and decode (Q = 1) alike.
     """
-    w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
+    if cfg.n_experts:
+        w_qkv, w_out, router, w_up, w_down, ln_attn, ln_mlp = layer_params
+    else:
+        w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
     batch, q_len, _ = x.shape
     h, kv, dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
     group = h // kv
@@ -118,15 +116,17 @@ def _attend_layer(cfg: TransformerConfig, x, layer_params, k_slab, v_slab,
     x = x + attended.reshape(batch, q_len, h * dh) @ w_out.astype(dtype)
 
     normed = _rmsnorm(x, ln_mlp)
-    x = x + jax.nn.gelu(normed @ w_up.astype(dtype)) @ w_down.astype(dtype)
+    if cfg.n_experts:
+        from kvedge_tpu.models.moe import routed_ffn_block
+
+        x = x + routed_ffn_block(normed, router, w_up, w_down)
+    else:
+        x = x + jax.nn.gelu(normed @ w_up.astype(dtype)) @ w_down.astype(dtype)
     return x, k_slab, v_slab
 
 
-def _stacked(params: dict):
-    return (
-        params["w_qkv"], params["w_out"], params["w_up"], params["w_down"],
-        params["ln_attn"], params["ln_mlp"],
-    )
+def _stacked(params: dict, cfg: TransformerConfig):
+    return stacked_layer_params(params, cfg)
 
 
 def _run_layers(cfg: TransformerConfig, params: dict, x, cache: KVCache, pos):
@@ -139,7 +139,9 @@ def _run_layers(cfg: TransformerConfig, params: dict, x, cache: KVCache, pos):
         )
         return out, (k_slab, v_slab)
 
-    x, (new_k, new_v) = lax.scan(body, x, (_stacked(params), cache.k, cache.v))
+    x, (new_k, new_v) = lax.scan(
+        body, x, (_stacked(params, cfg), cache.k, cache.v)
+    )
     x = _rmsnorm(x, params["ln_final"])
     logits = tied_readout(x[:, -1], params["embedding"])
     new_cache = KVCache(k=new_k, v=new_v, length=pos + x.shape[1])

@@ -75,11 +75,6 @@ class PagedKVCache:
     def __init__(self, cfg: TransformerConfig, *, slots: int, pages: int,
                  page_size: int = 16, max_pages_per_seq: int | None = None):
         cfg.validate()
-        if cfg.n_experts:
-            raise NotImplementedError(
-                "paged decoding does not support MoE configs (n_experts > "
-                "0); see models/decode.py:init_cache for the same limit"
-            )
         self.cfg = cfg
         self.slots = slots
         self.page_size = page_size
@@ -249,7 +244,10 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     """Shared block body. x: [B, Q, D]; q_positions: [B, Q] absolute
     positions of the new tokens. ``slot`` non-None = single-sequence
     prefill (B == 1 view of that slot)."""
-    w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
+    if cfg.n_experts:
+        w_qkv, w_out, router, w_up, w_down, ln_attn, ln_mlp = layer_params
+    else:
+        w_qkv, w_out, w_up, w_down, ln_attn, ln_mlp = layer_params
     batch, q_len, _ = x.shape
     h, kv, dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
     group = h // kv
@@ -300,7 +298,12 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     x = x + attended.reshape(batch, q_len, h * dh) @ w_out.astype(dtype)
 
     normed = _rmsnorm(x, ln_mlp)
-    x = x + jax.nn.gelu(normed @ w_up.astype(dtype)) @ w_down.astype(dtype)
+    if cfg.n_experts:
+        from kvedge_tpu.models.moe import routed_ffn_block
+
+        x = x + routed_ffn_block(normed, router, w_up, w_down)
+    else:
+        x = x + jax.nn.gelu(normed @ w_up.astype(dtype)) @ w_down.astype(dtype)
     return x, new_pool_k, new_pool_v
 
 
@@ -314,7 +317,7 @@ def _run_paged(cfg, params, state, x, q_positions, slot=None):
         return out, (pool_k_l, pool_v_l)
 
     x, (new_k, new_v) = jax.lax.scan(
-        body, x, (_stacked(params), state.pool_k, state.pool_v)
+        body, x, (_stacked(params, cfg), state.pool_k, state.pool_v)
     )
     x = _rmsnorm(x, params["ln_final"])
     logits = tied_readout(x[:, -1], params["embedding"])

@@ -104,3 +104,48 @@ def moe_ffn(x, router_w, w_up, w_down, *, capacity_factor: float,
     combine = (dispatch_ohc * gate[:, None, None]).astype(dtype)
     out = jnp.einsum("nec,ecd->nd", combine, expert_out)    # [N, D]
     return out, aux_loss
+
+
+def moe_ffn_dropless(x, router_w, w_up, w_down):
+    """Per-token routed FFN without capacity limits — the serving path.
+
+    x: [N, D]; router_w [D, E] fp32; w_up [E, D, F] / w_down [E, F, D]
+    (compute dtype). Returns [N, D].
+
+    At decode time there is no load to balance and no batch-wide cumsum
+    to keep static: each token simply runs through its argmax expert,
+    scaled by the router gate — the same per-token math as the training
+    path's dispatch/combine, so cached decode agrees with the
+    teacher-forced forward pass *provided training capacity never bound*
+    (capacity_factor >= n_experts guarantees zero drops; a token dropped
+    in training forward but served here would diverge).
+
+    Implementation gathers each token's expert weights ([N, D, F]) —
+    ideal for decode (N = batch) and fine for probe-scale prefill;
+    large-batch MoE prefill wants the einsum-dispatch path instead
+    (future work, README).
+    """
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                 # [N, E]
+    expert_index = jnp.argmax(probs, axis=-1)               # [N]
+    gate = jnp.max(probs, axis=-1)                          # [N]
+    dtype = x.dtype
+    w_up_tok = w_up[expert_index].astype(dtype)             # [N, D, F]
+    w_down_tok = w_down[expert_index].astype(dtype)         # [N, F, D]
+    hidden = jax.nn.gelu(jnp.einsum("nd,ndf->nf", x, w_up_tok))
+    out = jnp.einsum("nf,nfd->nd", hidden, w_down_tok)
+    return out * gate[:, None].astype(dtype)
+
+
+def routed_ffn_block(normed, router_w, w_up, w_down):
+    """The serving layers' MoE MLP block: [B, Q, D] in, [B, Q, D] out.
+
+    Shared by the contiguous (decode.py) and paged (kvcache.py) decode
+    paths so the two cannot drift — just the flatten/route/unflatten
+    around :func:`moe_ffn_dropless`.
+    """
+    batch, q_len, d = normed.shape
+    out = moe_ffn_dropless(
+        normed.reshape(batch * q_len, d), router_w, w_up, w_down
+    )
+    return out.reshape(batch, q_len, d)

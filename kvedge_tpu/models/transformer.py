@@ -44,9 +44,11 @@ class TransformerConfig:
     # Mixture-of-experts FFN (models/moe.py): 0 = dense. With n_experts
     # set, every layer's FFN becomes E switch-routed experts whose
     # stacked weights shard over an ``expert`` mesh axis — parameter
-    # scale-out without per-token FLOP growth. Training-path only: the
-    # decode/serving paths (models/decode.py, models/kvcache.py) reject
-    # MoE configs explicitly.
+    # scale-out without per-token FLOP growth. The serving paths
+    # (models/decode.py, models/kvcache.py) route per-token without
+    # capacity limits; cached decode agrees with the teacher-forced
+    # forward pass exactly when training capacity never binds
+    # (expert_capacity_factor >= n_experts guarantees that).
     n_experts: int = 0
     # Per-expert slot headroom: capacity = ceil(tokens/E * factor);
     # tokens routed past capacity are dropped (residual carries them).
@@ -182,6 +184,23 @@ def tied_readout(x, embedding):
     """
     return jnp.dot(
         x, embedding.T.astype(x.dtype), preferred_element_type=jnp.float32
+    )
+
+
+def stacked_layer_params(params: dict, cfg: TransformerConfig) -> tuple:
+    """The per-layer param tuple in the order ``_layer`` (and the decode
+    paths' layer bodies) unpack it. One definition, switched on
+    ``cfg.n_experts``, so training and serving cannot disagree about the
+    tuple shape or ordering."""
+    if cfg.n_experts:
+        return (
+            params["w_qkv"], params["w_out"], params["router"],
+            params["w_up_experts"], params["w_down_experts"],
+            params["ln_attn"], params["ln_mlp"],
+        )
+    return (
+        params["w_qkv"], params["w_out"], params["w_up"], params["w_down"],
+        params["ln_attn"], params["ln_mlp"],
     )
 
 
@@ -335,17 +354,7 @@ def forward_with_aux(params: dict, tokens, cfg: TransformerConfig,
 
         x = constrain(x)
 
-    if cfg.n_experts:
-        stacked = (
-            params["w_qkv"], params["w_out"], params["router"],
-            params["w_up_experts"], params["w_down_experts"],
-            params["ln_attn"], params["ln_mlp"],
-        )
-    else:
-        stacked = (
-            params["w_qkv"], params["w_out"], params["w_up"],
-            params["w_down"], params["ln_attn"], params["ln_mlp"],
-        )
+    stacked = stacked_layer_params(params, cfg)
 
     if cfg.pipeline_stages > 1:
         if mesh is None:

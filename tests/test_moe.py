@@ -170,18 +170,60 @@ def test_moe_composes_with_tensor_parallelism():
     assert np.isfinite(float(loss))
 
 
-def test_decode_rejects_moe():
-    from kvedge_tpu.models import init_cache
+# Serving: the decode paths route per-token without capacity limits, so
+# they agree with the teacher-forced forward pass exactly when training
+# capacity never binds — pin capacity_factor = n_experts (zero drops).
+SERVE_CFG = dataclasses.replace(
+    MOE_CFG, expert_capacity_factor=float(MOE_CFG.n_experts), max_seq=32
+)
 
-    with pytest.raises(NotImplementedError, match="MoE"):
-        init_cache(MOE_CFG, batch=1)
+
+def test_moe_generate_matches_argmax_of_forward():
+    from kvedge_tpu.models import generate
+    from kvedge_tpu.models.transformer import forward
+
+    params = init_params(jax.random.PRNGKey(0), SERVE_CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                SERVE_CFG.vocab, dtype=jnp.int32)
+    out = generate(params, prompt, SERVE_CFG, n_new=6)
+    assert out.shape == (2, 14)
+    # Teacher-force the generated tokens through the cache-less forward
+    # pass: greedy argmax at each generated position must agree.
+    logits = forward(params, out[:, :-1], SERVE_CFG)
+    for pos in range(8 - 1, 14 - 1):
+        np.testing.assert_array_equal(
+            np.asarray(jnp.argmax(logits[:, pos], axis=-1)),
+            np.asarray(out[:, pos + 1]),
+            err_msg=f"divergence at position {pos + 1}",
+        )
 
 
-def test_paged_cache_rejects_moe():
-    from kvedge_tpu.models import PagedKVCache
+def test_moe_paged_matches_contiguous():
+    from kvedge_tpu.models import PagedKVCache, decode_step, init_cache, prefill
 
-    with pytest.raises(NotImplementedError, match="MoE"):
-        PagedKVCache(MOE_CFG, slots=1, pages=4)
+    params = init_params(jax.random.PRNGKey(0), SERVE_CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (8,), 0,
+                                SERVE_CFG.vocab, dtype=jnp.int32)
+
+    paged = PagedKVCache(SERVE_CFG, slots=2, pages=8, page_size=8)
+    paged.admit(0, 8)
+    paged_logits = paged.prefill(params, 0, prompt)
+
+    cache = init_cache(SERVE_CFG, batch=1, max_seq=32)
+    contig_logits, cache = prefill(params, prompt[None], cache, SERVE_CFG)
+    np.testing.assert_allclose(
+        np.asarray(paged_logits), np.asarray(contig_logits[0]),
+        rtol=2e-2, atol=2e-2,
+    )
+
+    for step in range(4):
+        tok = jnp.argmax(contig_logits, axis=-1).astype(jnp.int32)
+        got = paged.step(params, jnp.stack([tok[0], jnp.int32(0)]))
+        contig_logits, cache = decode_step(params, cache, tok, SERVE_CFG)
+        np.testing.assert_allclose(
+            np.asarray(got[0]), np.asarray(contig_logits[0]),
+            rtol=2e-2, atol=2e-2, err_msg=f"step {step}",
+        )
 
 
 def test_validate_rejects_bad_moe_config():
