@@ -119,14 +119,12 @@ def _attend_layer(cfg: TransformerConfig, x, layer_params, k_slab, v_slab,
     if cfg.n_experts:
         from kvedge_tpu.models.moe import routed_ffn_block
 
-        x = x + routed_ffn_block(normed, router, w_up, w_down)
+        x = x + routed_ffn_block(
+            normed, router, w_up, w_down, top_k=cfg.expert_top_k
+        )
     else:
         x = x + jax.nn.gelu(normed @ w_up.astype(dtype)) @ w_down.astype(dtype)
     return x, k_slab, v_slab
-
-
-def _stacked(params: dict, cfg: TransformerConfig):
-    return stacked_layer_params(params, cfg)
 
 
 def _run_layers(cfg: TransformerConfig, params: dict, x, cache: KVCache, pos):
@@ -140,7 +138,7 @@ def _run_layers(cfg: TransformerConfig, params: dict, x, cache: KVCache, pos):
         return out, (k_slab, v_slab)
 
     x, (new_k, new_v) = lax.scan(
-        body, x, (_stacked(params, cfg), cache.k, cache.v)
+        body, x, (stacked_layer_params(params, cfg), cache.k, cache.v)
     )
     x = _rmsnorm(x, params["ln_final"])
     logits = tied_readout(x[:, -1], params["embedding"])

@@ -35,9 +35,9 @@ from kvedge_tpu.models.transformer import (
     _rmsnorm,
     _rotary,
     split_qkv,
+    stacked_layer_params,
     tied_readout,
 )
-from kvedge_tpu.models.decode import _stacked
 
 
 @jax.tree_util.register_dataclass
@@ -301,7 +301,9 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     if cfg.n_experts:
         from kvedge_tpu.models.moe import routed_ffn_block
 
-        x = x + routed_ffn_block(normed, router, w_up, w_down)
+        x = x + routed_ffn_block(
+            normed, router, w_up, w_down, top_k=cfg.expert_top_k
+        )
     else:
         x = x + jax.nn.gelu(normed @ w_up.astype(dtype)) @ w_down.astype(dtype)
     return x, new_pool_k, new_pool_v
@@ -317,7 +319,8 @@ def _run_paged(cfg, params, state, x, q_positions, slot=None):
         return out, (pool_k_l, pool_v_l)
 
     x, (new_k, new_v) = jax.lax.scan(
-        body, x, (_stacked(params, cfg), state.pool_k, state.pool_v)
+        body, x, (stacked_layer_params(params, cfg), state.pool_k,
+                  state.pool_v)
     )
     x = _rmsnorm(x, params["ln_final"])
     logits = tied_readout(x[:, -1], params["embedding"])

@@ -50,46 +50,72 @@ def expert_capacity(n_tokens: int, n_experts: int,
     return max(1, math.ceil(n_tokens * capacity_factor / n_experts))
 
 
+def _route(x, router_w, top_k: int):
+    """Shared routing decision. Returns (probs [N, E], idx [N, k],
+    gates [N, k] fp32).
+
+    Gate convention follows the source papers: top-1 uses the raw router
+    probability (Switch); top-2 normalizes the pair to sum to 1 (GShard).
+    Both training dispatch and the dropless serving path call this, so
+    the two cannot disagree about gating.
+    """
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                 # [N, E]
+    topk_probs, topk_idx = lax.top_k(probs, top_k)          # [N, k]
+    if top_k == 1:
+        gates = topk_probs
+    else:
+        gates = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+    return probs, topk_idx, gates
+
+
 def moe_ffn(x, router_w, w_up, w_down, *, capacity_factor: float,
-            mesh=None, expert_axis: str = "expert"):
-    """Top-1 MoE feed-forward. x: [N, D] tokens (any leading flattening).
+            top_k: int = 1, mesh=None, expert_axis: str = "expert"):
+    """Top-k (k = 1 or 2) MoE feed-forward. x: [N, D] tokens.
 
     router_w: [D, E] fp32; w_up: [E, D, F]; w_down: [E, F, D] (compute
     dtype). Returns ``(out [N, D], aux_loss scalar fp32)``.
+
+    Top-2: each token dispatches to its two highest-probability experts
+    with gates normalized over the pair (GShard). Capacity accounting
+    gives first choices strict priority — every token's first choice
+    claims its expert slot before any second choice does — and capacity
+    itself scales with k (k dispatches per token).
     """
     n_tokens, d = x.shape
     n_experts = router_w.shape[-1]
-    capacity = expert_capacity(n_tokens, n_experts, capacity_factor)
+    capacity = expert_capacity(top_k * n_tokens, n_experts, capacity_factor)
 
-    # Routing in fp32.
-    router_logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    probs = jax.nn.softmax(router_logits, axis=-1)          # [N, E]
-    expert_index = jnp.argmax(probs, axis=-1)               # [N]
-    onehot = jax.nn.one_hot(expert_index, n_experts,
-                            dtype=jnp.float32)              # [N, E]
-    gate = jnp.sum(probs * onehot, axis=-1)                 # [N]
+    probs, topk_idx, gates = _route(x, router_w, top_k)
+    onehots = jax.nn.one_hot(topk_idx, n_experts,
+                             dtype=jnp.float32)             # [N, k, E]
 
-    # Position of each token within its expert's capacity buffer; tokens
-    # past capacity get dropped (mask -> 0) — shapes stay static.
-    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # [N, E]
+    # Flatten (choice, token) with all FIRST choices before any second
+    # choice, so the cumsum-based capacity positions give first choices
+    # strict priority. Each flat row then routes independently, exactly
+    # like the top-1 scheme.
+    flat = onehots.transpose(1, 0, 2).reshape(
+        top_k * n_tokens, n_experts
+    )                                                        # [kN, E]
+    position = jnp.cumsum(flat, axis=0) * flat - 1.0
     within = (position < capacity) & (position >= 0)
-    dispatch = jnp.where(within, onehot, 0.0)               # [N, E]
-    # Each kept token's slot index: position at its expert's column
-    # (dispatch is the mask, so dropped tokens contribute a zero row in
+    dispatch = jnp.where(within, flat, 0.0)                 # [kN, E]
+    # Each kept row's slot index (dropped rows contribute a zero row in
     # dispatch_ohc regardless of the slot value picked here).
     slot_index = jnp.sum(position * dispatch, axis=-1).astype(jnp.int32)
-    slot = jax.nn.one_hot(slot_index, capacity, dtype=jnp.float32)  # [N, C]
-    dispatch_ohc = dispatch[:, :, None] * slot[:, None, :]  # [N, E, C]
+    slot = jax.nn.one_hot(slot_index, capacity, dtype=jnp.float32)
+    dispatch_ohc = dispatch[:, :, None] * slot[:, None, :]  # [kN, E, C]
 
-    # Aux load-balancing loss over the *pre-capacity* routing decision
-    # (Switch Transformer eq. 4): minimized at 1.0 for uniform routing.
-    fraction = jnp.mean(onehot, axis=0)                     # [E]
+    # Aux load-balancing loss over the *pre-capacity* FIRST-choice
+    # routing (Switch Transformer eq. 4): minimized at 1.0 when uniform.
+    fraction = jnp.mean(onehots[:, 0, :], axis=0)           # [E]
     mean_prob = jnp.mean(probs, axis=0)                     # [E]
     aux_loss = n_experts * jnp.sum(fraction * mean_prob)
 
     dtype = x.dtype
+    x_flat = jnp.tile(x, (top_k, 1))                        # [kN, D]
     expert_in = jnp.einsum(
-        "nec,nd->ecd", dispatch_ohc.astype(dtype), x
+        "nec,nd->ecd", dispatch_ohc.astype(dtype), x_flat
     )                                                        # [E, C, D]
     if mesh is not None and expert_axis in mesh.axis_names:
         constrain = NamedSharding(mesh, P(expert_axis, None, None))
@@ -101,43 +127,47 @@ def moe_ffn(x, router_w, w_up, w_down, *, capacity_factor: float,
     if mesh is not None and expert_axis in mesh.axis_names:
         expert_out = lax.with_sharding_constraint(expert_out, constrain)
 
-    combine = (dispatch_ohc * gate[:, None, None]).astype(dtype)
-    out = jnp.einsum("nec,ecd->nd", combine, expert_out)    # [N, D]
-    return out, aux_loss
+    gates_flat = gates.transpose(1, 0).reshape(top_k * n_tokens)
+    combine = (dispatch_ohc * gates_flat[:, None, None]).astype(dtype)
+    out_flat = jnp.einsum("nec,ecd->nd", combine, expert_out)  # [kN, D]
+    # Sum the k choices' contributions per token (choice-major layout).
+    return out_flat.reshape(top_k, n_tokens, d).sum(axis=0), aux_loss
 
 
-def moe_ffn_dropless(x, router_w, w_up, w_down):
+def moe_ffn_dropless(x, router_w, w_up, w_down, *, top_k: int = 1):
     """Per-token routed FFN without capacity limits — the serving path.
 
     x: [N, D]; router_w [D, E] fp32; w_up [E, D, F] / w_down [E, F, D]
     (compute dtype). Returns [N, D].
 
     At decode time there is no load to balance and no batch-wide cumsum
-    to keep static: each token simply runs through its argmax expert,
-    scaled by the router gate — the same per-token math as the training
-    path's dispatch/combine, so cached decode agrees with the
-    teacher-forced forward pass *provided training capacity never bound*
-    (capacity_factor >= n_experts guarantees zero drops; a token dropped
-    in training forward but served here would diverge).
+    to keep static: each token simply runs through its top-k experts,
+    combined with the same gates the training path uses (:func:`_route`),
+    so cached decode agrees with the teacher-forced forward pass
+    *provided training capacity never bound* (capacity_factor >=
+    n_experts guarantees zero drops; a dispatch dropped in training
+    forward but served here would diverge).
 
-    Implementation gathers each token's expert weights ([N, D, F]) —
-    ideal for decode (N = batch) and fine for probe-scale prefill;
-    large-batch MoE prefill wants the einsum-dispatch path instead
-    (future work, README).
+    Implementation gathers each token's expert weights ([N, D, F] per
+    choice) — ideal for decode (N = batch) and fine for probe-scale
+    prefill; large-batch MoE prefill wants the einsum-dispatch path
+    instead (future work, README).
     """
-    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)                 # [N, E]
-    expert_index = jnp.argmax(probs, axis=-1)               # [N]
-    gate = jnp.max(probs, axis=-1)                          # [N]
+    _, topk_idx, gates = _route(x, router_w, top_k)
     dtype = x.dtype
-    w_up_tok = w_up[expert_index].astype(dtype)             # [N, D, F]
-    w_down_tok = w_down[expert_index].astype(dtype)         # [N, F, D]
-    hidden = jax.nn.gelu(jnp.einsum("nd,ndf->nf", x, w_up_tok))
-    out = jnp.einsum("nf,nfd->nd", hidden, w_down_tok)
-    return out * gate[:, None].astype(dtype)
+    out = None
+    for choice in range(top_k):
+        idx = topk_idx[:, choice]
+        w_up_tok = w_up[idx].astype(dtype)                  # [N, D, F]
+        w_down_tok = w_down[idx].astype(dtype)              # [N, F, D]
+        hidden = jax.nn.gelu(jnp.einsum("nd,ndf->nf", x, w_up_tok))
+        contrib = jnp.einsum("nf,nfd->nd", hidden, w_down_tok)
+        contrib = contrib * gates[:, choice, None].astype(dtype)
+        out = contrib if out is None else out + contrib
+    return out
 
 
-def routed_ffn_block(normed, router_w, w_up, w_down):
+def routed_ffn_block(normed, router_w, w_up, w_down, *, top_k: int = 1):
     """The serving layers' MoE MLP block: [B, Q, D] in, [B, Q, D] out.
 
     Shared by the contiguous (decode.py) and paged (kvcache.py) decode
@@ -146,6 +176,7 @@ def routed_ffn_block(normed, router_w, w_up, w_down):
     """
     batch, q_len, d = normed.shape
     out = moe_ffn_dropless(
-        normed.reshape(batch * q_len, d), router_w, w_up, w_down
+        normed.reshape(batch * q_len, d), router_w, w_up, w_down,
+        top_k=top_k,
     )
     return out.reshape(batch, q_len, d)

@@ -50,9 +50,13 @@ class TransformerConfig:
     # forward pass exactly when training capacity never binds
     # (expert_capacity_factor >= n_experts guarantees that).
     n_experts: int = 0
-    # Per-expert slot headroom: capacity = ceil(tokens/E * factor);
-    # tokens routed past capacity are dropped (residual carries them).
+    # Per-expert slot headroom: capacity = ceil(k*tokens/E * factor);
+    # dispatches routed past capacity are dropped (residual carries them).
     expert_capacity_factor: float = 1.25
+    # Experts per token: 1 = Switch (gate = raw router prob), 2 = GShard
+    # (gates normalized over the pair; first choices take capacity
+    # priority over second choices).
+    expert_top_k: int = 1
     # Weight of the router's load-balancing aux loss in the training
     # loss (Switch Transformer uses 1e-2).
     moe_aux_weight: float = 0.01
@@ -110,6 +114,14 @@ class TransformerConfig:
             raise ValueError("n_experts must be >= 0 (0 = dense FFN)")
         if self.n_experts and self.expert_capacity_factor <= 0:
             raise ValueError("expert_capacity_factor must be > 0")
+        if self.n_experts:
+            if self.expert_top_k not in (1, 2):
+                raise ValueError("expert_top_k must be 1 or 2")
+            if self.expert_top_k > self.n_experts:
+                raise ValueError(
+                    f"expert_top_k {self.expert_top_k} needs at least "
+                    f"that many experts (n_experts={self.n_experts})"
+                )
         if self.pipeline_stages < 0:
             raise ValueError("pipeline_stages must be >= 0 (0 = off)")
         if self.pipeline_microbatches < 0:
@@ -318,7 +330,8 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
 
         out, aux = moe_ffn(
             normed.reshape(batch * seq, d), router, w_up, w_down,
-            capacity_factor=cfg.expert_capacity_factor, mesh=mesh,
+            capacity_factor=cfg.expert_capacity_factor,
+            top_k=cfg.expert_top_k, mesh=mesh,
         )
         x = x + out.reshape(batch, seq, d)
     else:

@@ -170,6 +170,94 @@ def test_moe_composes_with_tensor_parallelism():
     assert np.isfinite(float(loss))
 
 
+def test_top2_matches_reference_implementation():
+    # Small case with generous capacity: top-2 output must equal the
+    # hand-written per-token reference sum_j gate_j * ffn_j(x).
+    key = jax.random.PRNGKey(4)
+    x = jax.random.normal(key, (12, 8), jnp.float32)
+    router = jax.random.normal(jax.random.fold_in(key, 1), (8, 4))
+    w_up = jax.random.normal(jax.random.fold_in(key, 2), (4, 8, 16))
+    w_down = jax.random.normal(jax.random.fold_in(key, 3), (4, 16, 8))
+    got, _ = moe_ffn(x, router, w_up, w_down, capacity_factor=4.0, top_k=2)
+
+    probs = jax.nn.softmax(x @ router, axis=-1)
+    top2_probs, top2_idx = jax.lax.top_k(probs, 2)
+    gates = top2_probs / top2_probs.sum(axis=-1, keepdims=True)
+    want = np.zeros_like(np.asarray(x))
+    for n in range(x.shape[0]):
+        for j in range(2):
+            e = int(top2_idx[n, j])
+            f = np.asarray(
+                jax.nn.gelu(x[n] @ w_up[e]) @ w_down[e]
+            )
+            want[n] += float(gates[n, j]) * f
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+
+def test_top2_first_choice_has_capacity_priority():
+    # Token 0 routes (e0, e1); token 1 routes (e1, e2); capacity 1 slot
+    # per expert. The contested slot is expert 1's: under choice-major
+    # priority, token 1's FIRST choice wins it and token 0's SECOND
+    # choice is dropped. A token-major (no-priority) dispatch would give
+    # the slot to token 0's second choice instead — this test
+    # distinguishes the two.
+    x = jnp.eye(2, 4, dtype=jnp.float32)  # one-hot tokens: logits = rows of router
+    router = jnp.array([
+        [5.0, 4.0, -9.0, -9.0],   # token 0: top2 = (e0, e1)
+        [-9.0, 5.0, 4.0, -9.0],   # token 1: top2 = (e1, e2)
+        [0.0, 0.0, 0.0, 0.0],
+        [0.0, 0.0, 0.0, 0.0],
+    ], jnp.float32)
+    # Distinct per-expert outputs: f_e(x_n) = 4 * gelu(w) per dim, where
+    # w = e + 1 for a one-hot token.
+    w_up = jnp.stack([jnp.full((4, 4), float(e + 1)) for e in range(4)])
+    w_down = jnp.ones((4, 4, 4), jnp.float32)
+    # capacity = ceil(2*2/4 * 0.5) = 1
+    out, _ = moe_ffn(x, router, w_up, w_down, capacity_factor=0.5, top_k=2)
+
+    probs = jax.nn.softmax(router[:2], axis=-1)
+    g = jax.lax.top_k(probs, 2)[0]
+    g = np.asarray(g / g.sum(axis=-1, keepdims=True))
+
+    def f(e):  # per-dim expert output for a one-hot token
+        return 4.0 * float(jax.nn.gelu(jnp.float32(e + 1.0)))
+
+    # Kept: token0 first (e0); token1 first (e1) + second (e2).
+    # Dropped: token0 second (e1) — lost the contested slot.
+    want = np.zeros((2, 4), np.float32)
+    want[0] = g[0, 0] * f(0)
+    want[1] = g[1, 0] * f(1) + g[1, 1] * f(2)
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
+
+
+def test_top2_train_step_runs_and_learns():
+    cfg = dataclasses.replace(MOE_CFG, expert_top_k=2)
+    mesh = build_mesh(MeshSpec(axes=(("data", 2), ("expert", 4))))
+    params = shard_params(mesh, init_params(jax.random.PRNGKey(0), cfg))
+    init_opt, train_step = make_train_step(cfg, mesh=mesh)
+    opt_state = init_opt(params)
+    batch = shard_batch(
+        mesh,
+        jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab,
+                           dtype=jnp.int32),
+    )
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_top_k_validation():
+    with pytest.raises(ValueError, match="top_k"):
+        dataclasses.replace(MOE_CFG, expert_top_k=3).validate()
+    with pytest.raises(ValueError, match="top_k"):
+        dataclasses.replace(
+            MOE_CFG, n_experts=1, expert_top_k=2
+        ).validate()
+
+
 # Serving: the decode paths route per-token without capacity limits, so
 # they agree with the teacher-forced forward pass exactly when training
 # capacity never binds — pin capacity_factor = n_experts (zero drops).
@@ -178,18 +266,20 @@ SERVE_CFG = dataclasses.replace(
 )
 
 
-def test_moe_generate_matches_argmax_of_forward():
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_generate_matches_argmax_of_forward(top_k):
     from kvedge_tpu.models import generate
     from kvedge_tpu.models.transformer import forward
 
-    params = init_params(jax.random.PRNGKey(0), SERVE_CFG)
+    cfg = dataclasses.replace(SERVE_CFG, expert_top_k=top_k)
+    params = init_params(jax.random.PRNGKey(0), cfg)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
-                                SERVE_CFG.vocab, dtype=jnp.int32)
-    out = generate(params, prompt, SERVE_CFG, n_new=6)
+                                cfg.vocab, dtype=jnp.int32)
+    out = generate(params, prompt, cfg, n_new=6)
     assert out.shape == (2, 14)
     # Teacher-force the generated tokens through the cache-less forward
     # pass: greedy argmax at each generated position must agree.
-    logits = forward(params, out[:, :-1], SERVE_CFG)
+    logits = forward(params, out[:, :-1], cfg)
     for pos in range(8 - 1, 14 - 1):
         np.testing.assert_array_equal(
             np.asarray(jnp.argmax(logits[:, pos], axis=-1)),
