@@ -8,13 +8,15 @@ FLOPs.
 
 TPU-first design decisions:
 
-* **Switch-style top-1 routing with a static capacity.** Every shape is
-  compile-time constant: each expert processes exactly
-  ``C = ceil(tokens/E * capacity_factor)`` slots, tokens routed past an
-  expert's capacity are *dropped* (their FFN contribution is zero and
-  the residual connection carries them through — the standard Switch
-  Transformer trade that keeps XLA shapes static instead of introducing
-  data-dependent gather/scatter).
+* **Top-k routing (k = 1 Switch, k = 2 GShard) with a static capacity.**
+  Every shape is compile-time constant: each expert processes exactly
+  ``C = ceil(k * tokens/E * capacity_factor)`` slots, and dispatches
+  routed past an expert's capacity are *dropped* (their FFN contribution
+  is zero and the residual connection carries them through — the
+  standard trade that keeps XLA shapes static instead of introducing
+  data-dependent gather/scatter). First choices take capacity priority
+  over second choices; top-1 gates with the raw router probability,
+  top-2 normalizes the pair.
 * **Dispatch and combine are einsums with one-hot tensors**, not
   scatters: ``[N, E, C]`` dispatch against ``[N, D]`` activations gives
   ``[E, C, D]`` expert inputs on the MXU, and the transpose einsum
@@ -28,10 +30,10 @@ TPU-first design decisions:
   numerically load-bearing); expert FFN matmuls in the model's compute
   dtype (bf16 on TPU).
 
-The router's load-balancing aux loss (Switch eq. 4: ``E * Σ_e f_e·P_e``,
-minimized at 1.0 when routing is uniform) is returned alongside the
-output and folded into the training loss by ``loss_fn`` — without it,
-top-1 routing collapses onto a few experts.
+The router's load-balancing aux loss (Switch eq. 4 over *first* choices:
+``E * Σ_e f_e·P_e``, minimized at 1.0 when routing is uniform) is
+returned alongside the output and folded into the training loss by
+``loss_fn`` — without it, learned routing collapses onto a few experts.
 """
 
 from __future__ import annotations
@@ -112,10 +114,22 @@ def moe_ffn(x, router_w, w_up, w_down, *, capacity_factor: float,
     mean_prob = jnp.mean(probs, axis=0)                     # [E]
     aux_loss = n_experts * jnp.sum(fraction * mean_prob)
 
+    # Merge the k choices back to per-token dispatch/combine tensors
+    # before the big einsums: a token's choices route to *distinct*
+    # experts and every kept dispatch owns a unique (expert, slot), so
+    # the per-choice one-hots never overlap and summing them is exact —
+    # and the dispatch/combine einsums then run over N rows, not kN.
+    dispatch_tok = dispatch_ohc.reshape(
+        top_k, n_tokens, n_experts, capacity
+    )                                                        # [k, N, E, C]
+    gates_flat = gates.transpose(1, 0).reshape(top_k * n_tokens)
+    combine_tok = (
+        dispatch_ohc * gates_flat[:, None, None]
+    ).reshape(top_k, n_tokens, n_experts, capacity)
+
     dtype = x.dtype
-    x_flat = jnp.tile(x, (top_k, 1))                        # [kN, D]
     expert_in = jnp.einsum(
-        "nec,nd->ecd", dispatch_ohc.astype(dtype), x_flat
+        "nec,nd->ecd", dispatch_tok.sum(axis=0).astype(dtype), x
     )                                                        # [E, C, D]
     if mesh is not None and expert_axis in mesh.axis_names:
         constrain = NamedSharding(mesh, P(expert_axis, None, None))
@@ -127,11 +141,9 @@ def moe_ffn(x, router_w, w_up, w_down, *, capacity_factor: float,
     if mesh is not None and expert_axis in mesh.axis_names:
         expert_out = lax.with_sharding_constraint(expert_out, constrain)
 
-    gates_flat = gates.transpose(1, 0).reshape(top_k * n_tokens)
-    combine = (dispatch_ohc * gates_flat[:, None, None]).astype(dtype)
-    out_flat = jnp.einsum("nec,ecd->nd", combine, expert_out)  # [kN, D]
-    # Sum the k choices' contributions per token (choice-major layout).
-    return out_flat.reshape(top_k, n_tokens, d).sum(axis=0), aux_loss
+    combine = combine_tok.sum(axis=0).astype(dtype)          # [N, E, C]
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out)     # [N, D]
+    return out, aux_loss
 
 
 def moe_ffn_dropless(x, router_w, w_up, w_down, *, top_k: int = 1):

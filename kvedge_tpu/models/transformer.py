@@ -41,6 +41,11 @@ class TransformerConfig:
     # (notably the [T, T] attention scores, which otherwise live for every
     # layer at once under lax.scan) — the standard HBM-for-FLOPs trade.
     remat: bool = True
+    # What remat may keep: "full" recomputes everything in backward;
+    # "dots" saves matmul outputs (jax.checkpoint_policies
+    # .dots_with_no_batch_dims_saveable) and recomputes only the cheap
+    # elementwise work — less recompute FLOPs for modest extra HBM.
+    remat_policy: str = "full"
     # Mixture-of-experts FFN (models/moe.py): 0 = dense. With n_experts
     # set, every layer's FFN becomes E switch-routed experts whose
     # stacked weights shard over an ``expert`` mesh axis — parameter
@@ -122,6 +127,11 @@ class TransformerConfig:
                     f"expert_top_k {self.expert_top_k} needs at least "
                     f"that many experts (n_experts={self.n_experts})"
                 )
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got "
+                f"{self.remat_policy!r}"
+            )
         if self.pipeline_stages < 0:
             raise ValueError("pipeline_stages must be >= 0 (0 = off)")
         if self.pipeline_microbatches < 0:
@@ -214,6 +224,13 @@ def stacked_layer_params(params: dict, cfg: TransformerConfig) -> tuple:
         params["w_qkv"], params["w_out"], params["w_up"], params["w_down"],
         params["ln_attn"], params["ln_mlp"],
     )
+
+
+def _remat_policy(cfg: TransformerConfig):
+    """jax.checkpoint policy for cfg.remat_policy (None = save nothing)."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return None
 
 
 def _rmsnorm(x, gain):
@@ -382,6 +399,7 @@ def forward_with_aux(params: dict, tokens, cfg: TransformerConfig,
             lambda carry, lp: _layer(cfg, carry, lp, None)[0],
             mesh, n_layers=cfg.n_layers,
             n_microbatches=cfg.pipeline_microbatches, remat=cfg.remat,
+            remat_policy=_remat_policy(cfg),
         )
         aux = jnp.zeros((), jnp.float32)  # pipeline excludes MoE (validate)
         x = _rmsnorm(x, params["ln_final"])
@@ -394,7 +412,7 @@ def forward_with_aux(params: dict, tokens, cfg: TransformerConfig,
         return out, aux
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=_remat_policy(cfg))
     x, aux_per_layer = lax.scan(body, x, stacked)
     x = _rmsnorm(x, params["ln_final"])
     return tied_readout(x, embedding), jnp.mean(aux_per_layer)

@@ -47,7 +47,8 @@ def _stage_specs(n_arrays: int, data_axis: str | None):
 
 def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
                     stage_axis: str = "stage", data_axis: str = "data",
-                    n_microbatches: int = 0, remat: bool = True):
+                    n_microbatches: int = 0, remat: bool = True,
+                    remat_policy=None):
     """Run ``n_layers`` stacked layers over ``x``, pipelined over stages.
 
     x: [B, T, D] (compute dtype); ``stacked``: tuple of layer-stacked
@@ -101,7 +102,7 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
         def apply_local_layers(h):
             body_fn = layer_fn
             if remat:
-                body_fn = jax.checkpoint(body_fn)
+                body_fn = jax.checkpoint(body_fn, policy=remat_policy)
             h, _ = lax.scan(
                 lambda carry, lp: (body_fn(carry, lp), None),
                 h, stacked_local,

@@ -118,3 +118,21 @@ def test_param_rules_cover_tree(tiny_params):
 def test_config_validation():
     with pytest.raises(ValueError):
         TransformerConfig(d_model=100, n_heads=7).validate()
+    with pytest.raises(ValueError, match="remat_policy"):
+        TransformerConfig(remat_policy="everything").validate()
+
+
+def test_remat_policy_dots_matches_full(tiny_params):
+    import dataclasses
+
+    from kvedge_tpu.models.transformer import loss_fn
+
+    batch = jax.random.randint(jax.random.PRNGKey(3), (2, 17), 0, TINY.vocab)
+    dots = dataclasses.replace(TINY, remat_policy="dots")
+    got = jax.grad(loss_fn)(tiny_params, batch, dots)
+    want = jax.grad(loss_fn)(tiny_params, batch, TINY)
+    for name in want:
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]), atol=1e-5,
+            err_msg=f"grad mismatch in {name}",
+        )
