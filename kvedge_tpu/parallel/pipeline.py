@@ -26,9 +26,13 @@ TPU-first design:
   hand-written stage logic.
 
 Composes with ``data`` parallelism (microbatches shard their batch dim on
-``data``; the two axes are orthogonal). Sequence-parallel attention and
-MoE layers are rejected for now — their collectives would have to nest
-inside the stage-local layer body (future work, README).
+``data``) and with ``model`` tensor parallelism: only stage/data go
+manual in the shard_map, so a ``model`` axis stays *automatic* and XLA
+keeps Megatron-partitioning the stacked params' feature dims (and
+inserting the tp collectives) inside each stage body. Sequence-parallel
+attention and MoE layers are rejected for now — their own manual
+collectives would have to nest inside the stage-local layer body
+(future work, README).
 """
 
 from __future__ import annotations
@@ -62,20 +66,24 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
             f"mesh has no {stage_axis!r} axis (axes: {sorted(axis_sizes)}) "
             "— pipeline parallelism needs a stage axis"
         )
-    if "model" in axis_sizes and axis_sizes["model"] > 1:
-        # The shard_map's in_specs name only the stage/data axes, so a
-        # model axis would silently all-gather the tensor-parallel dims
-        # of every stacked param onto each device — refuse rather than
-        # quietly replicate (pp×tp composition is future work, README).
-        raise ValueError(
-            "pipeline parallelism does not compose with a 'model' "
-            "(tensor-parallel) mesh axis yet"
-        )
     stages = axis_sizes[stage_axis]
     if n_layers % stages:
         raise ValueError(
             f"n_layers {n_layers} must divide by the {stage_axis!r} axis "
             f"size {stages} (whole layers per stage)"
+        )
+    if ("model" in axis_sizes and axis_sizes["model"] > 1
+            and x.dtype == jnp.bfloat16
+            and jax.default_backend() == "cpu"):
+        # XLA's CPU layout-assignment pass crashes the process ("Invalid
+        # binary instruction opcode copy") on bf16 contractions against
+        # auto-partitioned operands inside shard_map — a backend compiler
+        # bug (observed on jax 0.9.0 / CPU only; the TPU backend compiles
+        # this fine). A loud error beats a segfault in test environments.
+        raise ValueError(
+            "bf16 pipeline x tensor parallelism trips an XLA CPU-backend "
+            "compiler crash; use float32 compute (dtype='float32') when "
+            "testing this combination on the CPU backend"
         )
     batch = x.shape[0]
     micro = n_microbatches or stages
@@ -145,10 +153,19 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
         outputs = jnp.where(stage == stages - 1, outputs, 0.0)
         return lax.psum(outputs, stage_axis)
 
+    # Only the stage (and data) axes go manual; any other mesh axis —
+    # notably a Megatron ``model`` axis on the stacked params' feature
+    # dims — stays *automatic*: XLA keeps partitioning those dims and
+    # inserting the tensor-parallel collectives inside each stage body,
+    # so pp composes with tp without the specs having to name it.
+    manual = frozenset(
+        {stage_axis} | ({data_axis} if dspec else set())
+    )
     out = jax.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=_stage_specs(len(stacked), dspec),
         out_specs=P(None, dspec, None, None),
+        axis_names=manual,
     )(x_mb, *stacked)
     return out.reshape(batch, *x.shape[1:])

@@ -70,16 +70,18 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
     # A ``stage`` axis pipelines the probe's layer stack (GPipe schedule
     # with ppermute hand-offs). Probe layers scale to one per stage.
     stages = axis_sizes.get("stage", 1)
-    if stages > 1 and (sp > 1 or n_experts > 1 or model_axis > 1):
+    if stages > 1 and (sp > 1 or n_experts > 1):
         # A healthy runtime with an un-runnable mesh combination: surface
         # a clear config message, not a generic "probe failed" traceback.
+        # (stage x model IS supported — the model axis stays automatic
+        # inside the pipeline's shard_map.)
         return dataclasses.replace(
             base, ok=False,
             error=(
-                "mesh combines 'stage' with "
-                "'seq'/'expert'/'model' — pipeline parallelism does not "
-                "compose with sequence/expert/tensor parallelism yet "
-                "(README future work); use one scale-out family per mesh"
+                "mesh combines 'stage' with 'seq'/'expert' — pipeline "
+                "parallelism does not compose with sequence/expert "
+                "parallelism yet (README future work); use one scale-out "
+                "family per mesh"
             ),
         )
     try:
@@ -89,6 +91,13 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
         n_layers = PROBE_LAYERS
         if stages > 1 and n_layers % stages:
             n_layers = stages  # one layer per stage
+        # pp x tp probes run fp32: bf16 contractions against the
+        # auto-partitioned model axis crash XLA's CPU backend (see
+        # parallel/pipeline.py), and the probe must be portable across
+        # the CPU test mesh and real TPUs. The probe verifies machinery,
+        # not dtype throughput.
+        dtype = ("float32" if stages > 1 and model_axis > 1
+                 else TransformerConfig.dtype)
         tcfg = TransformerConfig(
             vocab=PROBE_VOCAB,
             d_model=PROBE_D_MODEL,
@@ -96,6 +105,7 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
             n_layers=n_layers,
             d_ff=4 * PROBE_D_MODEL,
             max_seq=PROBE_SEQ,
+            dtype=dtype,
             attention=attention,
             n_experts=n_experts if n_experts > 1 else 0,
             pipeline_stages=stages if stages > 1 else 0,

@@ -40,11 +40,24 @@ def pp_mesh(stages=4, data=2):
     )
 
 
-def test_pipeline_forward_matches_plain_scan():
-    mesh = pp_mesh()
+# Mesh variants the pipeline must behave identically on: dp×pp, and
+# pp×tp (the model axis stays automatic inside the pipeline shard_map).
+PP_MESHES = {
+    "dp-pp": (("data", 2), ("stage", 4)),
+    "pp-tp": (("data", 1), ("stage", 4), ("model", 2)),
+}
+
+
+def mesh_from(axes):
+    return build_mesh(MeshSpec(axes=axes))
+
+
+@pytest.mark.parametrize("axes", PP_MESHES.values(), ids=PP_MESHES.keys())
+def test_pipeline_forward_matches_plain_scan(axes):
+    mesh = mesh_from(axes)
     params = init_params(jax.random.PRNGKey(0), PP_CFG)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
-    got = forward(params, tokens, PP_CFG, mesh)
+    got = forward(shard_params(mesh, params), tokens, PP_CFG, mesh)
     want = forward(params, tokens, DENSE_CFG)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-4)
 
@@ -84,8 +97,9 @@ def test_pipeline_gradients_match_plain_scan():
         )
 
 
-def test_pipeline_train_step_runs_and_learns():
-    mesh = pp_mesh()
+@pytest.mark.parametrize("axes", PP_MESHES.values(), ids=PP_MESHES.keys())
+def test_pipeline_train_step_runs_and_learns(axes):
+    mesh = mesh_from(axes)
     params = shard_params(mesh, init_params(jax.random.PRNGKey(0), PP_CFG))
     init_opt, train_step = make_train_step(PP_CFG, mesh=mesh)
     opt_state = init_opt(params)
@@ -147,16 +161,40 @@ def test_config_validation():
         dataclasses.replace(PP_CFG, pipeline_microbatches=-2).validate()
 
 
-def test_pipeline_rejects_model_axis_mesh():
-    # pp×tp composition is future work: the shard_map would silently
-    # all-gather the tensor-parallel dims, so it must refuse instead.
+
+
+def test_pipeline_bf16_with_model_axis_fails_loudly_on_cpu():
+    # bf16 contractions against the auto-partitioned model axis crash
+    # XLA's CPU backend outright; the guard turns the segfault into a
+    # ValueError. (On TPU the combination compiles fine.)
     mesh = build_mesh(
         MeshSpec(axes=(("data", 1), ("stage", 4), ("model", 2)))
     )
-    params = init_params(jax.random.PRNGKey(0), PP_CFG)
-    tokens = jnp.zeros((4, 16), jnp.int32)
-    with pytest.raises(ValueError, match="model"):
-        forward(params, tokens, PP_CFG, mesh)
+    cfg = dataclasses.replace(PP_CFG, dtype="bfloat16")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((8, 16), jnp.int32)
+    with pytest.raises(ValueError, match="CPU-backend"):
+        forward(params, tokens, cfg, mesh)
+
+
+def test_transformer_probe_pp_tp_mesh(tmp_path):
+    import math
+
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    cfg = dataclasses.replace(
+        RuntimeConfig(),
+        name="pp-tp-probe",
+        state_dir=str(tmp_path / "state"),
+        expected_platform="cpu",
+        status_port=0,
+        status_bind="127.0.0.1",
+        mesh=MeshSpec(axes=(("data", 1), ("stage", 4), ("model", 2))),
+    )
+    result = run_transformer_probe(cfg)
+    assert result.ok, result.error
+    assert math.isfinite(result.probe_checksum)
 
 
 def test_probe_reports_clear_error_for_stage_plus_seq_mesh(tmp_path):
