@@ -1,0 +1,17 @@
+"""Training-input pipeline: corpus files and the prefetching feeder."""
+
+from kvedge_tpu.data.feeder import (
+    PyTokenFeeder,
+    TokenFeeder,
+    open_feeder,
+    read_corpus_header,
+    write_corpus,
+)
+
+__all__ = [
+    "PyTokenFeeder",
+    "TokenFeeder",
+    "open_feeder",
+    "read_corpus_header",
+    "write_corpus",
+]

@@ -1,0 +1,213 @@
+// libkvedge-feed — native training-input feeder for the runtime.
+//
+// The training payload consumes fixed-shape [batch, seq+1] int32 token
+// batches (models/training.py). This library streams them from a binary
+// corpus file on the state volume with a *prefetch thread* and a bounded
+// ring buffer, so host-side IO and slicing overlap the device's step time
+// instead of serializing with it — the input-pipeline half of keeping the
+// MXU busy. Native C++ because the feeder must keep producing while the
+// Python thread is blocked inside a jit'd step (the GIL is released there,
+// but a Python feeder thread would contend for it on every batch; this
+// thread never touches Python at all).
+//
+// Corpus format (written by kvedge_tpu.data.write_corpus):
+//   8 bytes  magic   "KVFEED01"
+//   8 bytes  uint64  n_tokens (little-endian)
+//   N * 4    int32   tokens
+//
+// Batch layout: deterministic sequential order. Batch b row r covers
+// tokens [(b*batch + r) * seq, ... + seq + 1) — overlapping by one token
+// so targets = inputs shifted by one — wrapping around the corpus at the
+// end (an "epoch" is implicit). Deterministic order makes resume exact:
+// a consumer that restarts at step k sees the same batches (the
+// checkpoint/resume contract of models/training.py).
+//
+// C ABI (consumed via ctypes from kvedge_tpu/data/feeder.py):
+//   void* kvf_open(const char* path, int batch, int seq, int depth,
+//                  unsigned long long start_batch);
+//   int   kvf_next(void* h, int* out);        // blocking; 0 = ok
+//   const char* kvf_last_error();             // after a NULL open
+//   unsigned long long kvf_tokens(void* h);   // corpus token count
+//   void  kvf_close(void* h);
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'K', 'V', 'F', 'E', 'E', 'D', '0', '1'};
+constexpr size_t kHeaderBytes = 16;
+
+thread_local std::string g_last_error;
+
+struct Feeder {
+  int fd = -1;
+  const int32_t *tokens = nullptr;  // mmap'd, past the header
+  uint64_t n_tokens = 0;
+  size_t map_bytes = 0;
+  void *map_base = nullptr;
+
+  int batch = 0;
+  int seq = 0;
+  size_t batch_elems = 0;  // batch * (seq + 1)
+
+  // Bounded ring buffer of prefetched batches.
+  std::vector<std::vector<int32_t>> ring;
+  size_t head = 0, tail = 0, filled = 0;
+  std::mutex mu;
+  std::condition_variable can_produce, can_consume;
+  std::atomic<bool> stop{false};
+  uint64_t next_batch_index = 0;
+  std::thread worker;
+
+  ~Feeder() {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      stop = true;
+    }
+    can_produce.notify_all();
+    can_consume.notify_all();
+    if (worker.joinable()) worker.join();
+    if (map_base) munmap(map_base, map_bytes);
+    if (fd >= 0) close(fd);
+  }
+
+  void fill_batch(uint64_t index, int32_t *out) const {
+    // Row r of batch `index` starts at token (index*batch + r) * seq,
+    // wrapping modulo the corpus.
+    for (int r = 0; r < batch; ++r) {
+      uint64_t start =
+          (static_cast<uint64_t>(index) * batch + r) * seq % n_tokens;
+      size_t row_len = static_cast<size_t>(seq) + 1;
+      uint64_t contiguous = n_tokens - start;
+      if (contiguous >= row_len) {
+        memcpy(out, tokens + start, row_len * sizeof(int32_t));
+      } else {
+        memcpy(out, tokens + start, contiguous * sizeof(int32_t));
+        memcpy(out + contiguous, tokens,
+               (row_len - contiguous) * sizeof(int32_t));
+      }
+      out += row_len;
+    }
+  }
+
+  void run() {
+    std::vector<int32_t> scratch(batch_elems);
+    while (true) {
+      fill_batch(next_batch_index, scratch.data());
+      std::unique_lock<std::mutex> lock(mu);
+      can_produce.wait(lock,
+                       [&] { return stop || filled < ring.size(); });
+      if (stop) return;
+      ring[tail].swap(scratch);
+      tail = (tail + 1) % ring.size();
+      ++filled;
+      ++next_batch_index;
+      lock.unlock();
+      can_consume.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *kvf_last_error() { return g_last_error.c_str(); }
+
+void *kvf_open(const char *path, int batch, int seq, int depth,
+               unsigned long long start_batch) {
+  if (batch <= 0 || seq <= 0 || depth <= 0) {
+    g_last_error = "batch, seq, and depth must be positive";
+    return nullptr;
+  }
+  auto feeder = new Feeder();
+  feeder->fd = open(path, O_RDONLY);
+  if (feeder->fd < 0) {
+    g_last_error = std::string("cannot open ") + path;
+    delete feeder;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(feeder->fd, &st) != 0 ||
+      static_cast<size_t>(st.st_size) < kHeaderBytes) {
+    g_last_error = "corpus file too small for header";
+    delete feeder;
+    return nullptr;
+  }
+  feeder->map_bytes = st.st_size;
+  feeder->map_base =
+      mmap(nullptr, feeder->map_bytes, PROT_READ, MAP_PRIVATE, feeder->fd, 0);
+  if (feeder->map_base == MAP_FAILED) {
+    feeder->map_base = nullptr;
+    g_last_error = "mmap failed";
+    delete feeder;
+    return nullptr;
+  }
+  const char *base = static_cast<const char *>(feeder->map_base);
+  if (memcmp(base, kMagic, sizeof kMagic) != 0) {
+    g_last_error = "bad corpus magic (expected KVFEED01)";
+    delete feeder;
+    return nullptr;
+  }
+  uint64_t n_tokens;
+  memcpy(&n_tokens, base + 8, sizeof n_tokens);
+  // Divide instead of multiply: n_tokens * 4 could wrap uint64 for a
+  // corrupt header and bypass the bound check entirely.
+  uint64_t max_tokens =
+      (static_cast<uint64_t>(st.st_size) - kHeaderBytes) / sizeof(int32_t);
+  if (n_tokens > max_tokens) {
+    g_last_error = "corpus header claims more tokens than the file holds";
+    delete feeder;
+    return nullptr;
+  }
+  if (n_tokens < static_cast<uint64_t>(seq) + 1) {
+    g_last_error = "corpus smaller than one sequence";
+    delete feeder;
+    return nullptr;
+  }
+  feeder->tokens = reinterpret_cast<const int32_t *>(base + kHeaderBytes);
+  feeder->n_tokens = n_tokens;
+  feeder->batch = batch;
+  feeder->seq = seq;
+  feeder->batch_elems = static_cast<size_t>(batch) * (seq + 1);
+  feeder->ring.resize(depth);
+  for (auto &slot : feeder->ring) slot.resize(feeder->batch_elems);
+  feeder->next_batch_index = start_batch;
+  feeder->worker = std::thread(&Feeder::run, feeder);
+  return feeder;
+}
+
+int kvf_next(void *h, int32_t *out) {
+  auto feeder = static_cast<Feeder *>(h);
+  std::unique_lock<std::mutex> lock(feeder->mu);
+  feeder->can_consume.wait(
+      lock, [&] { return feeder->stop.load() || feeder->filled > 0; });
+  if (feeder->stop) return 1;
+  memcpy(out, feeder->ring[feeder->head].data(),
+         feeder->batch_elems * sizeof(int32_t));
+  feeder->head = (feeder->head + 1) % feeder->ring.size();
+  --feeder->filled;
+  lock.unlock();
+  feeder->can_produce.notify_one();
+  return 0;
+}
+
+unsigned long long kvf_tokens(void *h) {
+  return static_cast<Feeder *>(h)->n_tokens;
+}
+
+void kvf_close(void *h) { delete static_cast<Feeder *>(h); }
+
+}  // extern "C"
