@@ -71,8 +71,14 @@ def cmd_apply(argv: list[str], root: str) -> None:
         cfg = RuntimeConfig.parse(text)
     except RuntimeConfigError as e:
         raise CommandError(f"injected config is invalid: {e}") from e
-    # Rebase the state dir too so `apply` stays inside the test root.
-    cfg = dataclasses.replace(cfg, state_dir=rebase(cfg.state_dir, root))
+    # Rebase the in-pod paths too so `apply` stays inside the test root.
+    cfg = dataclasses.replace(
+        cfg,
+        state_dir=rebase(cfg.state_dir, root),
+        train_corpus=(
+            rebase(cfg.train_corpus, root) if cfg.train_corpus else ""
+        ),
+    )
     cfg.apply(config_path=rebase(args.target, root))
 
 

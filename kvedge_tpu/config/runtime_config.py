@@ -32,7 +32,7 @@ DEFAULT_CONFIG_PATH = "/etc/kvedge/config.toml"
 DEFAULT_STATE_DIR = "/var/lib/kvedge/state"
 
 _VALID_PAYLOADS = (
-    "devicecheck", "transformer-probe", "inference-probe", "none",
+    "devicecheck", "transformer-probe", "inference-probe", "train", "none",
 )
 # "" = auto (ring iff the mesh declares a seq axis); the rest match
 # TransformerConfig.attention (models/transformer.py).
@@ -147,6 +147,16 @@ class RuntimeConfig:
     # select a specific sequence-parallel strategy ("ring"/"ulysses") or
     # kernel ("flash"/"naive").
     payload_attention: str = ""
+    # The "train" payload: resumable training over a token corpus on the
+    # state volume. ``train_corpus`` is the corpus path (required for the
+    # payload; rebased like every other in-pod path); steps count from 0
+    # across ALL pod generations — a rescheduled pod resumes from the
+    # latest checkpoint and the feeder continues at the exact batch.
+    train_corpus: str = ""
+    train_steps: int = 100
+    train_batch: int = 8
+    train_seq: int = 128
+    train_checkpoint_every: int = 10
 
     @classmethod
     def parse(cls, text: str) -> "RuntimeConfig":
@@ -204,6 +214,16 @@ class RuntimeConfig:
                 payload_attention=str(
                     payload_doc.get("attention", cls.payload_attention)
                 ),
+                train_corpus=str(
+                    payload_doc.get("corpus", cls.train_corpus)
+                ),
+                train_steps=int(payload_doc.get("steps", cls.train_steps)),
+                train_batch=int(payload_doc.get("batch", cls.train_batch)),
+                train_seq=int(payload_doc.get("seq", cls.train_seq)),
+                train_checkpoint_every=int(
+                    payload_doc.get("checkpoint_every",
+                                    cls.train_checkpoint_every)
+                ),
             )
         except (TypeError, ValueError) as e:
             if isinstance(e, RuntimeConfigError):
@@ -232,6 +252,18 @@ class RuntimeConfig:
                 f"[payload] attention must be one of {_VALID_ATTENTION}, "
                 f"got {self.payload_attention!r}"
             )
+        if self.payload == "train" and not self.train_corpus:
+            raise RuntimeConfigError(
+                "[payload] kind = 'train' requires corpus = '<path>' "
+                "(a KVFEED01 token file, typically on the state volume)"
+            )
+        for field_name in ("train_steps", "train_batch", "train_seq",
+                           "train_checkpoint_every"):
+            if getattr(self, field_name) <= 0:
+                toml_key = field_name.removeprefix("train_")
+                raise RuntimeConfigError(
+                    f"[payload] {toml_key} must be positive"
+                )
         self.mesh.validate()
         self.distributed.validate()
 
@@ -265,6 +297,11 @@ class RuntimeConfig:
             "\n[payload]\n"
             f"kind = {s(self.payload)}\n"
             f"attention = {s(self.payload_attention)}\n"
+            f"corpus = {s(self.train_corpus)}\n"
+            f"steps = {self.train_steps}\n"
+            f"batch = {self.train_batch}\n"
+            f"seq = {self.train_seq}\n"
+            f"checkpoint_every = {self.train_checkpoint_every}\n"
         )
 
     def apply(self, config_path: str = DEFAULT_CONFIG_PATH) -> str:

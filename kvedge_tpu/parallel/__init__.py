@@ -17,6 +17,7 @@ from kvedge_tpu.parallel.sharding import (
     param_specs,
     shard_params,
     shard_batch,
+    shard_tree,
 )
 
 __all__ = [
@@ -29,5 +30,6 @@ __all__ = [
     "sequence_sharding",
     "shard_params",
     "shard_batch",
+    "shard_tree",
     "ulysses_attention",
 ]

@@ -98,3 +98,25 @@ def shard_params(mesh, params: dict) -> dict:
 
 def shard_batch(mesh, batch):
     return jax.device_put(batch, NamedSharding(mesh, batch_spec(mesh)))
+
+
+def shard_tree(mesh, tree):
+    """Shard a params dict OR any optimizer-state tree containing them.
+
+    optax states (e.g. ``ScaleByAdamState``) nest param-shaped dicts
+    (``mu``/``nu``) inside tuples next to scalars; each such dict gets
+    the same placement rules as the params it mirrors (so momentum lives
+    with its weight) and everything else is left untouched. This is the
+    ``prepare=`` callable for the resumable training driver.
+    """
+    def maybe_shard(sub):
+        if (isinstance(sub, dict) and sub
+                and set(sub) <= set(PARAM_RULES)):
+            return shard_params(mesh, sub)
+        return sub
+
+    if isinstance(tree, dict):
+        return maybe_shard(tree)
+    return jax.tree_util.tree_map(
+        maybe_shard, tree, is_leaf=lambda x: isinstance(x, dict)
+    )

@@ -129,6 +129,10 @@ def _run_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             from kvedge_tpu.runtime.workload import run_inference_probe
 
             return run_inference_probe(cfg)
+        if cfg.payload == "train":
+            from kvedge_tpu.runtime.workload import run_train_payload
+
+            return run_train_payload(cfg)
         return run_device_check(cfg)
     except Exception as e:
         return _degraded(f"payload {cfg.payload!r} failed: {e!r}")

@@ -146,6 +146,107 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
     )
 
 
+def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
+    """The "train" payload: resumable training over a corpus on the PVC.
+
+    The full persistence story, live: train ``[payload] steps`` total
+    steps over the ``corpus`` token file, checkpointing through the
+    state volume. A rescheduled pod restores the latest checkpoint and
+    reopens the feeder at exactly that batch (deterministic order), so
+    steps count from 0 across ALL pod generations — the payload-level
+    analogue of EdgeHub's PVC-backed message state (reference
+    ``README.md:88``). A run whose target was already reached reports ok
+    immediately.
+    """
+    base = run_device_check(cfg)
+    if not base.ok:
+        return base
+
+    import dataclasses
+    import functools
+    import math
+
+    from kvedge_tpu.data import open_feeder
+    from kvedge_tpu.models import TransformerConfig
+    from kvedge_tpu.models.training import run_training
+    from kvedge_tpu.parallel import build_mesh, shard_batch, shard_tree
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+
+    axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
+    unsupported = {"seq", "expert", "stage"} & {
+        axis for axis, size in axis_sizes.items() if size > 1
+    }
+    if unsupported:
+        return dataclasses.replace(
+            base, ok=False,
+            error=(
+                f"train payload supports data x model meshes only; axes "
+                f"{sorted(unsupported)} would be silently ignored — use "
+                "the transformer-probe payload to exercise them"
+            ),
+        )
+    data_size = axis_sizes.get("data", 1)
+    if cfg.train_batch % max(1, data_size):
+        return dataclasses.replace(
+            base, ok=False,
+            error=(
+                f"[payload] batch = {cfg.train_batch} must divide by the "
+                f"mesh's data axis size ({data_size}) — it is the global "
+                "batch, sharded across data-parallel devices"
+            ),
+        )
+    tcfg = TransformerConfig(
+        vocab=PROBE_VOCAB,
+        d_model=PROBE_D_MODEL,
+        n_heads=max(4, axis_sizes.get("model", 1)),
+        n_layers=PROBE_LAYERS,
+        d_ff=4 * PROBE_D_MODEL,
+        max_seq=cfg.train_seq,
+    )
+    feeder = None
+    try:
+        # Peek the resume point first: the feeder must start at the
+        # batch the restored step would consume next.
+        with StateCheckpointer(cfg.state_dir) as ckpt:
+            resume_step = ckpt.latest_step() or 0
+        feeder = open_feeder(
+            cfg.train_corpus, batch=cfg.train_batch, seq=cfg.train_seq,
+            start_batch=resume_step,
+        )
+        mesh = build_mesh(cfg.mesh)
+        # The payload model is compact (vocab 512); fold arbitrary token
+        # ids into range rather than letting the embedding lookup clamp
+        # them silently. Deterministic, so resume stays exact. Every
+        # batch and the (fresh or restored) state shard onto the mesh.
+        batches = (
+            shard_batch(mesh, batch % tcfg.vocab) for batch in feeder
+        )
+        start = time.perf_counter()
+        result = run_training(
+            tcfg, cfg.state_dir, num_steps=cfg.train_steps,
+            batches=batches, checkpoint_every=cfg.train_checkpoint_every,
+            prepare=functools.partial(shard_tree, mesh),
+        )
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+    except Exception as e:
+        return dataclasses.replace(
+            base, ok=False, error=f"train payload failed: {e!r}",
+        )
+    finally:
+        if feeder is not None:
+            feeder.close()
+    final_loss = result.losses[-1] if result.losses else float("nan")
+    if result.losses and not math.isfinite(final_loss):
+        return dataclasses.replace(
+            base, ok=False,
+            error=f"training diverged: loss {final_loss}",
+        )
+    return dataclasses.replace(
+        base, probe_ms=elapsed_ms,
+        probe_checksum=final_loss if result.losses else 0.0,
+    )
+
+
 # Inference probe: small GQA model, short prompt, a few greedy steps.
 PROBE_KV_HEADS = 2
 PROBE_PROMPT = 8

@@ -31,6 +31,7 @@
 //   void  kvf_close(void* h);
 
 #include <atomic>
+#include <memory>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -127,23 +128,22 @@ extern "C" {
 const char *kvf_last_error() { return g_last_error.c_str(); }
 
 void *kvf_open(const char *path, int batch, int seq, int depth,
-               unsigned long long start_batch) {
+               unsigned long long start_batch) try {
   if (batch <= 0 || seq <= 0 || depth <= 0) {
     g_last_error = "batch, seq, and depth must be positive";
     return nullptr;
   }
-  auto feeder = new Feeder();
+  auto owned = std::make_unique<Feeder>();
+  Feeder *feeder = owned.get();
   feeder->fd = open(path, O_RDONLY);
   if (feeder->fd < 0) {
     g_last_error = std::string("cannot open ") + path;
-    delete feeder;
     return nullptr;
   }
   struct stat st;
   if (fstat(feeder->fd, &st) != 0 ||
       static_cast<size_t>(st.st_size) < kHeaderBytes) {
     g_last_error = "corpus file too small for header";
-    delete feeder;
     return nullptr;
   }
   feeder->map_bytes = st.st_size;
@@ -152,13 +152,11 @@ void *kvf_open(const char *path, int batch, int seq, int depth,
   if (feeder->map_base == MAP_FAILED) {
     feeder->map_base = nullptr;
     g_last_error = "mmap failed";
-    delete feeder;
     return nullptr;
   }
   const char *base = static_cast<const char *>(feeder->map_base);
   if (memcmp(base, kMagic, sizeof kMagic) != 0) {
     g_last_error = "bad corpus magic (expected KVFEED01)";
-    delete feeder;
     return nullptr;
   }
   uint64_t n_tokens;
@@ -169,12 +167,10 @@ void *kvf_open(const char *path, int batch, int seq, int depth,
       (static_cast<uint64_t>(st.st_size) - kHeaderBytes) / sizeof(int32_t);
   if (n_tokens > max_tokens) {
     g_last_error = "corpus header claims more tokens than the file holds";
-    delete feeder;
     return nullptr;
   }
   if (n_tokens < static_cast<uint64_t>(seq) + 1) {
     g_last_error = "corpus smaller than one sequence";
-    delete feeder;
     return nullptr;
   }
   feeder->tokens = reinterpret_cast<const int32_t *>(base + kHeaderBytes);
@@ -186,7 +182,17 @@ void *kvf_open(const char *path, int batch, int seq, int depth,
   for (auto &slot : feeder->ring) slot.resize(feeder->batch_elems);
   feeder->next_batch_index = start_batch;
   feeder->worker = std::thread(&Feeder::run, feeder);
-  return feeder;
+  return owned.release();
+} catch (const std::exception &e) {
+  // C++ exceptions must not cross the C ABI into ctypes (std::terminate
+  // would abort the whole runtime process). The realistic throwers are
+  // the ring/thread allocations — e.g. an absurd batch*seq from a bad
+  // config ends here as std::bad_alloc, surfaced as a clean error.
+  g_last_error = std::string("kvf_open failed: ") + e.what();
+  return nullptr;
+} catch (...) {
+  g_last_error = "kvf_open failed: unknown C++ exception";
+  return nullptr;
 }
 
 int kvf_next(void *h, int32_t *out) {

@@ -307,6 +307,76 @@ def test_transformer_probe_ulysses_via_config(tmp_path):
     assert math.isfinite(result.probe_checksum)
 
 
+def _write_train_corpus(tmp_path, n_tokens=4000):
+    import numpy as np
+
+    from kvedge_tpu.data import write_corpus
+
+    path = tmp_path / "corpus.kvfeed"
+    rng = np.random.default_rng(3)
+    write_corpus(path, rng.integers(0, 512, size=n_tokens, dtype=np.int32))
+    return str(path)
+
+
+def test_train_payload_trains_and_reports_loss(tmp_path):
+    import math
+
+    corpus = _write_train_corpus(tmp_path)
+    handle = start_runtime(_cfg(
+        tmp_path, payload="train", train_corpus=corpus, train_steps=4,
+        train_batch=8, train_seq=16, train_checkpoint_every=2,
+    ))
+    try:
+        assert handle.check.ok, handle.check.error
+        assert math.isfinite(handle.check.probe_checksum)
+        assert handle.check.probe_ms > 0
+    finally:
+        handle.shutdown()
+
+
+def test_train_payload_resumes_across_pod_generations(tmp_path):
+    """The full persistence capability, live: generation 1 trains past a
+    checkpoint and 'dies'; generation 2 resumes from the checkpoint (not
+    step 0) and finishes the target — boot_count increments, steps don't
+    restart."""
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+
+    corpus = _write_train_corpus(tmp_path)
+
+    def boot(steps):
+        return start_runtime(_cfg(
+            tmp_path, payload="train", train_corpus=corpus,
+            train_steps=steps, train_batch=8, train_seq=16,
+            train_checkpoint_every=2,
+        ))
+
+    gen1 = boot(steps=4)
+    gen1.shutdown()
+    assert gen1.check.ok, gen1.check.error
+    with StateCheckpointer(str(tmp_path / "state")) as ckpt:
+        assert ckpt.latest_step() == 4
+
+    gen2 = boot(steps=8)
+    try:
+        assert gen2.check.ok, gen2.check.error
+        assert gen2.boot_count == 2  # state volume outlived the "pod"
+        with StateCheckpointer(str(tmp_path / "state")) as ckpt:
+            assert ckpt.latest_step() == 8
+    finally:
+        gen2.shutdown()
+
+
+def test_train_payload_requires_corpus():
+    import pytest
+
+    from kvedge_tpu.config.runtime_config import (
+        RuntimeConfig, RuntimeConfigError,
+    )
+
+    with pytest.raises(RuntimeConfigError, match="corpus"):
+        RuntimeConfig.parse("[payload]\nkind = 'train'\n")
+
+
 def test_status_server_answers_during_boot_work(tmp_path, monkeypatch):
     """The server must serve /version while the boot work is in flight.
 
