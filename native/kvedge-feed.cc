@@ -30,6 +30,8 @@
 //   unsigned long long kvf_tokens(void* h);   // corpus token count
 //   void  kvf_close(void* h);
 
+#include "kvedge-feed.h"
+
 #include <atomic>
 #include <memory>
 #include <condition_variable>

@@ -1,0 +1,33 @@
+// C ABI of libkvedge-feed — shared by the library (kvedge-feed.cc), the
+// sanitizer stress harness (feed-stress.cc), and documented for the
+// ctypes consumer (kvedge_tpu/data/feeder.py). One declaration site so a
+// signature change is a compile error in every native TU, not silent UB
+// through an unmangled extern "C" symbol.
+
+#ifndef KVEDGE_FEED_H_
+#define KVEDGE_FEED_H_
+
+#include <cstdint>
+
+extern "C" {
+
+// Opens a KVFEED01 corpus and starts the prefetch thread. Returns an
+// opaque handle, or NULL with kvf_last_error() set.
+void *kvf_open(const char *path, int batch, int seq, int depth,
+               unsigned long long start_batch);
+
+// Blocking copy of the next [batch, seq+1] int32 batch. 0 = ok.
+int kvf_next(void *h, int32_t *out);
+
+// Corpus token count.
+unsigned long long kvf_tokens(void *h);
+
+// Stops the prefetch thread and releases the mapping.
+void kvf_close(void *h);
+
+// Thread-local description of the most recent kvf_open failure.
+const char *kvf_last_error();
+
+}  // extern "C"
+
+#endif  // KVEDGE_FEED_H_
