@@ -77,6 +77,68 @@ def test_schedule_with_real_boots_tracks_state(tmp_path):
     assert result.reschedules >= 1
 
 
+TRAIN_TOML = """
+[runtime]
+name = "faults-train"
+
+[tpu]
+platform = "cpu"
+
+[status]
+port = 18996
+bind = "127.0.0.1"
+
+[payload]
+kind = "train"
+corpus = "/var/lib/kvedge/state/corpus.kvfeed"
+steps = 4
+batch = 8
+seq = 16
+checkpoint_every = 2
+"""
+
+
+def test_schedule_with_train_payload_checkpoints_survive(tmp_path):
+    """The full resilience x persistence story under injected faults:
+    every pod generation boots the *train* payload, and the orbax
+    checkpoints on the PVC backing survive each reschedule — training
+    progress is never lost, and a generation whose target was already
+    reached reports ok without redoing work."""
+    import numpy as np
+
+    from kvedge_tpu.data import write_corpus
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+
+    cluster = _cluster(tmp_path, n_nodes=2, resilient_storage=True)
+    values = DEFAULT_VALUES.replace(jaxRuntimeConfig=TRAIN_TOML)
+    chart = render_all(values)
+    # Pre-seed the corpus onto the PVC backing store (the operator's
+    # "upload the dataset to the volume" step).
+    claim = chart.manifests["jax-tpu-state-volume.yaml"]["metadata"]["name"]
+    backing = tmp_path / "pvc-backing" / claim
+    backing.mkdir(parents=True)
+    rng = np.random.default_rng(9)
+    write_corpus(
+        backing / "corpus.kvfeed",
+        rng.integers(0, 512, size=4000, dtype=np.int32),
+    )
+
+    cluster.apply(chart.manifests)
+    sched = FaultSchedule(
+        cluster, DEP, seed=5, boot_root=str(tmp_path / "boots")
+    )
+    result = sched.run(5)
+    assert result.boots >= 2 and result.reschedules >= 1
+
+    with StateCheckpointer(str(backing)) as ckpt:
+        assert ckpt.latest_step() == 4  # target reached, survived faults
+    import json
+
+    beat = json.loads((backing / "heartbeat.json").read_text())
+    assert beat["ok"] is True
+    assert beat["boot_count"] == result.boots
+
+
 def test_harness_catches_a_seeded_bug(tmp_path):
     """The harness must actually detect violations: break the controller
     (two Running pods) and expect InvariantViolation with a replay trace."""

@@ -31,9 +31,12 @@ def _build(target: str) -> pathlib.Path | None:
     """
     if shutil.which("g++") is None or shutil.which("make") is None:
         return None
+    # `check-stress` compiles the harness source with the plain
+    # toolchain, so a broken feed-stress.cc fails here loudly rather
+    # than masquerading as a missing sanitizer runtime below.
     plain = subprocess.run(
-        ["make", "-C", str(NATIVE_DIR), "all"], capture_output=True,
-        text=True,
+        ["make", "-C", str(NATIVE_DIR), "all", "check-stress"],
+        capture_output=True, text=True,
     )
     assert plain.returncode == 0, f"plain native build broken:\n{plain.stderr}"
     result = subprocess.run(
