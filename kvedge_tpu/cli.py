@@ -90,6 +90,55 @@ def cmd_version(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_corpus(args: argparse.Namespace) -> int:
+    """Write a KVFEED01 token corpus for the ``train`` payload.
+
+    Sources, exactly one of: ``--from-tokens`` (a text file of integer
+    token ids, whitespace/newline separated — the format any external
+    tokenizer can emit) or ``--random N`` (a seeded synthetic corpus for
+    smoke tests and demos).
+    """
+    import numpy as np
+
+    from kvedge_tpu.data import read_corpus_header, write_corpus
+
+    if (args.from_tokens is None) == (args.random is None):
+        raise ValueError(
+            "exactly one of --from-tokens or --random is required"
+        )
+    if args.from_tokens is not None:
+        # Whitespace/newline separated, no rectangularity requirement
+        # (np.loadtxt would reject ragged lines).
+        try:
+            words = pathlib.Path(args.from_tokens).read_text().split()
+            # Validate in Python ints first: a huge id must become the
+            # friendly error below, not an OverflowError from numpy.
+            ids = [int(w) for w in words]
+        except ValueError as e:
+            raise ValueError(f"--from-tokens must contain integers: {e}")
+        if not ids:
+            raise ValueError(
+                f"--from-tokens file {args.from_tokens} contains no "
+                "tokens; an empty corpus would only fail later at pod "
+                "boot"
+            )
+        if min(ids) < 0 or max(ids) > 2**31 - 1:
+            raise ValueError("token ids must fit in int32 and be >= 0")
+        tokens = np.array(ids, dtype=np.int32)
+    else:
+        if args.random <= 0:
+            raise ValueError("--random needs a positive token count")
+        rng = np.random.default_rng(args.seed)
+        tokens = rng.integers(0, args.vocab, size=args.random,
+                              dtype=np.int32)
+    write_corpus(args.out, tokens)
+    print(
+        f"wrote {read_corpus_header(args.out)} tokens to {args.out}",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="kvedge-tpu",
@@ -115,6 +164,25 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_version = sub.add_parser("version", help="print chart/app version")
     p_version.set_defaults(func=cmd_version)
+
+    p_corpus = sub.add_parser(
+        "corpus",
+        help="write a KVFEED01 token corpus for the `train` payload",
+    )
+    p_corpus.add_argument("--out", required=True, help="output corpus path")
+    p_corpus.add_argument(
+        "--from-tokens",
+        help="text file of integer token ids (whitespace separated)",
+    )
+    p_corpus.add_argument(
+        "--random", type=int,
+        help="generate N random tokens instead (seeded; smoke tests/demos)",
+    )
+    p_corpus.add_argument("--vocab", type=int, default=512,
+                          help="vocab for --random (default 512, the "
+                               "train payload's model vocab)")
+    p_corpus.add_argument("--seed", type=int, default=0)
+    p_corpus.set_defaults(func=cmd_corpus)
 
     return parser
 

@@ -13,6 +13,51 @@ def test_render_stdout(capsys):
     assert "You have installed release" in out.err
 
 
+def test_corpus_random_and_from_tokens(tmp_path, capsys):
+    import numpy as np
+
+    from kvedge_tpu.data import PyTokenFeeder, read_corpus_header
+
+    out = tmp_path / "r.kvfeed"
+    assert main(["corpus", "--out", str(out), "--random", "500"]) == 0
+    assert read_corpus_header(out) == 500
+    assert "wrote 500 tokens" in capsys.readouterr().err
+
+    ids = tmp_path / "ids.txt"
+    ids.write_text("5 6 7\n8 9 10 11\n")
+    out2 = tmp_path / "t.kvfeed"
+    assert main(["corpus", "--out", str(out2), "--from-tokens",
+                 str(ids)]) == 0
+    feeder = PyTokenFeeder(out2, batch=1, seq=6)
+    np.testing.assert_array_equal(next(feeder)[0], [5, 6, 7, 8, 9, 10, 11])
+
+
+def test_corpus_requires_exactly_one_source(tmp_path, capsys):
+    out = str(tmp_path / "x.kvfeed")
+    assert main(["corpus", "--out", out]) == 1
+    assert "exactly one" in capsys.readouterr().err
+    assert main(["corpus", "--out", out, "--random", "10",
+                 "--from-tokens", "f"]) == 1
+    assert main(["corpus", "--out", out, "--random", "-5"]) == 1
+
+
+def test_corpus_rejects_bad_token_files(tmp_path, capsys):
+    out = str(tmp_path / "x.kvfeed")
+    empty = tmp_path / "empty.txt"
+    empty.write_text("  \n")
+    assert main(["corpus", "--out", out, "--from-tokens",
+                 str(empty)]) == 1
+    assert "no tokens" in capsys.readouterr().err
+    huge = tmp_path / "huge.txt"
+    huge.write_text("99999999999999999999999\n")
+    assert main(["corpus", "--out", out, "--from-tokens", str(huge)]) == 1
+    assert "int32" in capsys.readouterr().err
+    negative = tmp_path / "neg.txt"
+    negative.write_text("3 -7\n")
+    assert main(["corpus", "--out", out, "--from-tokens",
+                 str(negative)]) == 1
+
+
 def test_render_with_sets_and_output_dir(tmp_path, capsys):
     cfg = tmp_path / "config.toml"
     cfg.write_text('[runtime]\nname = "cli-edge"\n')
