@@ -40,6 +40,7 @@ def test_cast_covers_the_end_to_end_story():
     transcript = "".join(ev[2] for ev in events)
     for landmark in (
         "kvedge_tpu render",            # manifests rendered by the CLI
+        "wrote 4000 tokens",            # corpus built for the train payload
         "jax-tpu-runtime.yaml",         # the core resource exists
         "Running",                      # pod scheduled
         "entrypoint exit code: 0",      # real entrypoint booted

@@ -92,7 +92,9 @@ def run(cmd: list[str], cwd: str) -> str:
     if proc.returncode != 0:
         sys.stderr.write(proc.stdout + proc.stderr)
         raise RuntimeError(f"{cmd} failed with exit {proc.returncode}")
-    return proc.stdout
+    # Both streams, as a terminal would show them (the CLI prints status
+    # lines like "wrote N manifests"/"wrote N tokens" to stderr).
+    return proc.stdout + proc.stderr
 
 
 def main() -> int:
@@ -107,6 +109,10 @@ def main() -> int:
     steps: list[tuple[str, list[str]]] = [
         ("python -m kvedge_tpu version",
          [python, "-m", "kvedge_tpu", "version"]),
+        ("python -m kvedge_tpu corpus --out corpus.kvfeed --random 4000  "
+         "# dataset for the resumable `train` payload",
+         [python, "-m", "kvedge_tpu", "corpus", "--out", "corpus.kvfeed",
+          "--random", "4000"]),
         ("cat config.toml",
          ["cat", "config.toml"]),
         ("python -m kvedge_tpu render "
