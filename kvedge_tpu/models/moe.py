@@ -161,9 +161,9 @@ def moe_ffn_dropless(x, router_w, w_up, w_down, *, top_k: int = 1):
     forward but served here would diverge).
 
     Implementation gathers each token's expert weights ([N, D, F] per
-    choice) — ideal for decode (N = batch) and fine for probe-scale
-    prefill; large-batch MoE prefill wants the einsum-dispatch path
-    instead (future work, README).
+    choice) — ideal for decode (N = batch). Large prefills go through
+    :func:`routed_ffn_block`, which switches to einsum dispatch past
+    ``_GATHER_MAX_TOKENS``.
     """
     _, topk_idx, gates = _route(x, router_w, top_k)
     dtype = x.dtype
@@ -179,16 +179,39 @@ def moe_ffn_dropless(x, router_w, w_up, w_down, *, top_k: int = 1):
     return out
 
 
+# The per-token weight gather materializes [chunk, D, F] weight copies —
+# ideal at decode (chunk = batch) but ~N/E x the whole layer's weights
+# for a long prefill. Past this many tokens the serving block runs the
+# SAME gather in lax.map'd chunks: routing stays per-token identical,
+# memory stays bounded at one chunk's weight copies, and cost stays
+# linear in N (matmul rounding can differ across chunk shapes, as it
+# already does between the gather and training-dispatch paths). A
+# dropless einsum dispatch is NOT a substitute here: guaranteeing zero
+# drops needs capacity = k*N, making the dispatch one-hots O(N^2).
+_GATHER_MAX_TOKENS = 64
+
+
 def routed_ffn_block(normed, router_w, w_up, w_down, *, top_k: int = 1):
     """The serving layers' MoE MLP block: [B, Q, D] in, [B, Q, D] out.
 
     Shared by the contiguous (decode.py) and paged (kvcache.py) decode
-    paths so the two cannot drift — just the flatten/route/unflatten
-    around :func:`moe_ffn_dropless`.
+    paths so the two cannot drift. Decode steps gather per-token expert
+    weights directly; long prefills run the identical gather chunked
+    under ``lax.map`` so weight-copy memory stays bounded.
     """
     batch, q_len, d = normed.shape
-    out = moe_ffn_dropless(
-        normed.reshape(batch * q_len, d), router_w, w_up, w_down,
-        top_k=top_k,
-    )
+    n_tokens = batch * q_len
+    flat = normed.reshape(n_tokens, d)
+    if n_tokens <= _GATHER_MAX_TOKENS:
+        out = moe_ffn_dropless(flat, router_w, w_up, w_down, top_k=top_k)
+    else:
+        chunk = _GATHER_MAX_TOKENS
+        pad = -n_tokens % chunk
+        padded = jnp.pad(flat, ((0, pad), (0, 0)))
+        out = lax.map(
+            lambda c: moe_ffn_dropless(
+                c, router_w, w_up, w_down, top_k=top_k
+            ),
+            padded.reshape(-1, chunk, d),
+        ).reshape(-1, d)[:n_tokens]
     return out.reshape(batch, q_len, d)

@@ -267,6 +267,50 @@ SERVE_CFG = dataclasses.replace(
 
 
 @pytest.mark.parametrize("top_k", [1, 2])
+@pytest.mark.parametrize("tokens", [150, 160])  # non-multiple + multiple of 64
+def test_serving_block_chunked_path_matches_gather(top_k, tokens):
+    # Past _GATHER_MAX_TOKENS the serving block runs the same per-token
+    # gather chunked under lax.map — routing is per-token identical
+    # (padding included); only matmul rounding may differ across chunk
+    # shapes.
+    from kvedge_tpu.models import moe
+
+    key = jax.random.PRNGKey(8)
+    router = jax.random.normal(jax.random.fold_in(key, 1), (16, 4))
+    w_up = jax.random.normal(jax.random.fold_in(key, 2), (4, 16, 32))
+    w_down = jax.random.normal(jax.random.fold_in(key, 3), (4, 32, 16))
+    x = jax.random.normal(key, (2, tokens // 2, 16), jnp.float32)
+
+    big = moe.routed_ffn_block(x, router, w_up, w_down, top_k=top_k)
+    gathered = moe.moe_ffn_dropless(
+        x.reshape(tokens, 16), router, w_up, w_down, top_k=top_k
+    ).reshape(x.shape)
+    np.testing.assert_allclose(
+        np.asarray(big), np.asarray(gathered), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_moe_long_prompt_prefill_matches_forward():
+    # A prompt past _GATHER_MAX_TOKENS routes prefill through the einsum
+    # dispatch path; greedy decode must still agree with teacher forcing.
+    from kvedge_tpu.models import generate
+    from kvedge_tpu.models.transformer import forward
+
+    cfg = dataclasses.replace(SERVE_CFG, max_seq=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (1, 96), 0,
+                                cfg.vocab, dtype=jnp.int32)  # 96 > 64
+    out = generate(params, prompt, cfg, n_new=4)
+    logits = forward(params, out[:, :-1], cfg)
+    for pos in range(95, 99):
+        np.testing.assert_array_equal(
+            np.asarray(jnp.argmax(logits[:, pos], axis=-1)),
+            np.asarray(out[:, pos + 1]),
+            err_msg=f"divergence at position {pos + 1}",
+        )
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
 def test_moe_generate_matches_argmax_of_forward(top_k):
     from kvedge_tpu.models import generate
     from kvedge_tpu.models.transformer import forward
