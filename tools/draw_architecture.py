@@ -88,15 +88,17 @@ def main() -> int:
             "per host on multi-host slices)",
             ha="center", va="top", fontsize=9.2, color=INK,
             fontweight="bold")
-    box(ax, 0.45, 0.475, 0.255, 0.115,
-        "bootstrap entrypoint\n#kvedge-boot-config: bootcmd → runcmd\n"
-        "(find config disk by serial, apply)", FILL_POD, fontsize=8.2)
-    box(ax, 0.45, 0.32, 0.255, 0.13,
-        "JAX TPU runtime\njax.distributed + Mesh(dp×tp / dp×sp)\n"
-        "device check · heartbeat · status :8476", FILL_POD, fontsize=8.2)
-    box(ax, 0.45, 0.155, 0.255, 0.14,
-        "payload\ntransformer-probe / inference-probe\n"
-        "(pjit over the mesh, Pallas flash attn)", FILL_POD, fontsize=8.2)
+    box(ax, 0.45, 0.525, 0.255, 0.095,
+        "kvedge-init (C++ PID 1)\nsupervise · restart/backoff · reap\n"
+        "→ bootstrap entrypoint (boot doc)", FILL_POD, fontsize=7.8)
+    box(ax, 0.45, 0.37, 0.255, 0.125,
+        "JAX TPU runtime\njax.distributed + Mesh(dp·tp·sp·ep·pp)\n"
+        "device check · heartbeat · status :8476", FILL_POD, fontsize=8.0)
+    box(ax, 0.45, 0.155, 0.255, 0.185,
+        "payload\ntransformer-probe / inference-probe /\n"
+        "train (libkvedge-feed C++ prefetcher,\n"
+        "orbax resume) — pjit over the mesh,\nPallas flash attn",
+        FILL_POD, fontsize=7.8)
 
     # Right column: secrets, state PVC, chips.
     box(ax, 0.755, 0.60, 0.185, 0.115,
