@@ -90,6 +90,67 @@ def cmd_version(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_package(args: argparse.Namespace) -> int:
+    """Package a Helm chart as ``<name>-<version>.tgz`` (helm package
+    analogue).
+
+    Packaging needs NO template parsing — only ``Chart.yaml`` metadata
+    and the ``.helmignore`` exclusions (shared with the renderer via
+    ``helmlite.load_helmignore``). The ignore file is load-bearing: it
+    is what keeps the dead prepopulated-volume template out of the
+    installable package (reference ``.helmignore:23-24``). The whole
+    chart tree is walked (crds/, charts/, README, ...), matching what
+    real helm includes, and the archive is byte-reproducible.
+    """
+    import gzip
+    import io
+    import tarfile
+
+    import yaml
+
+    from kvedge_tpu.render.helmlite import (
+        helmignore_matches,
+        load_helmignore,
+    )
+
+    chart_dir = pathlib.Path(args.chart_dir)
+    chart_yaml = chart_dir / "Chart.yaml"
+    if not chart_yaml.is_file():
+        raise ValueError(f"{chart_dir} has no Chart.yaml")
+    meta = yaml.safe_load(chart_yaml.read_text())
+    try:
+        name, version = meta["name"], str(meta["version"])
+    except (TypeError, KeyError):
+        raise ValueError(f"{chart_yaml} must declare name and version")
+    patterns = load_helmignore(chart_dir)
+    out_dir = pathlib.Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    out_path = out_dir / f"{name}-{version}.tgz"
+
+    members = []
+    for path in sorted(chart_dir.rglob("*")):
+        if not path.is_file():
+            continue
+        rel = path.relative_to(chart_dir).as_posix()
+        if rel != ".helmignore" and helmignore_matches(rel, patterns):
+            continue
+        members.append((rel, path.read_bytes()))
+
+    with open(out_path, "wb") as raw:
+        # mtime=0 in the gzip header too, or two identical packagings
+        # differ by wall clock — the archive must be reproducible.
+        with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as gz:
+            with tarfile.open(fileobj=gz, mode="w") as tar:
+                for rel, data in members:
+                    info = tarfile.TarInfo(f"{name}/{rel}")
+                    info.size = len(data)
+                    info.mtime = 0
+                    info.mode = 0o644
+                    tar.addfile(info, io.BytesIO(data))
+    print(f"wrote {out_path}", file=sys.stderr)
+    return 0
+
+
 def cmd_corpus(args: argparse.Namespace) -> int:
     """Write a KVFEED01 token corpus for the ``train`` payload.
 
@@ -183,6 +244,22 @@ def build_parser() -> argparse.ArgumentParser:
                                "train payload's model vocab)")
     p_corpus.add_argument("--seed", type=int, default=0)
     p_corpus.set_defaults(func=cmd_corpus)
+
+    p_package = sub.add_parser(
+        "package",
+        help="package the Helm chart as <name>-<version>.tgz "
+             "(helm package analogue, honors .helmignore)",
+    )
+    p_package.add_argument(
+        "--chart-dir", default=str(
+            pathlib.Path(__file__).resolve().parent.parent
+            / "deployment" / "helm"
+        ),
+        help="chart directory (default: the bundled chart)",
+    )
+    p_package.add_argument("--out-dir", default=".",
+                           help="where to write the .tgz (default: cwd)")
+    p_package.set_defaults(func=cmd_package)
 
     return parser
 

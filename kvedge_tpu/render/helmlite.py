@@ -38,6 +38,43 @@ class HelmLiteError(ValueError):
     """Raised on template constructs outside the supported subset."""
 
 
+def load_helmignore(chart_dir) -> list[str]:
+    """The chart's ``.helmignore`` patterns ([] if the file is absent).
+
+    Shared by the renderer's template loader and the CLI's ``package``
+    command so the two can never disagree about what the load-bearing
+    exclusions are (reference ``.helmignore:23-24``).
+    """
+    ignore_file = pathlib.Path(chart_dir) / ".helmignore"
+    patterns: list[str] = []
+    if ignore_file.exists():
+        for line in ignore_file.read_text().splitlines():
+            line = line.strip()
+            if line and not line.startswith("#"):
+                patterns.append(line)
+    return patterns
+
+
+def helmignore_matches(rel_path: str, patterns: list[str]) -> bool:
+    """True if ``rel_path`` (chart-relative, '/'-separated) is ignored.
+
+    Helm matches entries as shell globs against the relative path and
+    against each basename; ``dir/`` patterns match everything under that
+    directory.
+    """
+    name = rel_path.rsplit("/", 1)[-1]
+    for pat in patterns:
+        if pat.endswith("/"):
+            # Directory pattern: ignore anything under a path segment
+            # matching it, at any depth.
+            if ("/" + pat) in ("/" + rel_path):
+                return True
+            continue
+        if fnmatch.fnmatch(rel_path, pat) or fnmatch.fnmatch(name, pat):
+            return True
+    return False
+
+
 def _strip_left(text: str) -> str:
     return text.rstrip(" \t\n")
 
@@ -60,13 +97,7 @@ class Chart:
         self.default_values = yaml.safe_load((root / "values.yaml").read_text())
         self.defines: dict[str, str] = {}
         self.templates: dict[str, str] = {}
-        self._ignore_patterns: list[str] = []
-        ignore_file = root / ".helmignore"
-        if ignore_file.exists():
-            for line in ignore_file.read_text().splitlines():
-                line = line.strip()
-                if line and not line.startswith("#"):
-                    self._ignore_patterns.append(line)
+        self._ignore_patterns = load_helmignore(root)
         self.ignored = set()
         for path in sorted((root / "templates").iterdir()):
             if self._is_ignored(path.name):
@@ -84,13 +115,7 @@ class Chart:
                 self.templates[path.name] = text
 
     def _is_ignored(self, name: str) -> bool:
-        # helm matches .helmignore entries as shell globs (trailing-/ dir
-        # patterns cannot match a plain template filename).
-        return any(
-            fnmatch.fnmatch(name, pat)
-            for pat in self._ignore_patterns
-            if not pat.endswith("/")
-        )
+        return helmignore_matches(name, self._ignore_patterns)
 
     def _collect_defines(self, text: str) -> None:
         pos = 0

@@ -13,6 +13,73 @@ def test_render_stdout(capsys):
     assert "You have installed release" in out.err
 
 
+def test_package_honors_helmignore(tmp_path, capsys):
+    import tarfile
+
+    assert main(["package", "--out-dir", str(tmp_path)]) == 0
+    out = tmp_path / "kvedge-tpu-0.1.0.tgz"
+    assert out.exists()
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+    assert "kvedge-tpu/Chart.yaml" in names
+    assert "kvedge-tpu/values.yaml" in names
+    assert "kvedge-tpu/templates/jax-tpu-runtime.yaml" in names
+    # The load-bearing exclusion (reference .helmignore:23-24): the dead
+    # prepopulated-volume template must NOT ship in the package.
+    assert not any("prepopulated" in n for n in names)
+    # Reproducible: repackaging produces identical bytes.
+    first = out.read_bytes()
+    assert main(["package", "--out-dir", str(tmp_path)]) == 0
+    assert out.read_bytes() == first
+
+
+def test_package_arbitrary_chart_dir(tmp_path, capsys):
+    import tarfile
+
+    # A minimal foreign chart with helm-standard extras the renderer's
+    # template subset doesn't parse: packaging must still work.
+    chart = tmp_path / "mychart"
+    (chart / "templates" / "tests").mkdir(parents=True)
+    (chart / "crds").mkdir()
+    (chart / "Chart.yaml").write_text(
+        "name: mychart\nversion: 1.2.3\n"  # appVersion deliberately absent
+    )
+    (chart / "values.yaml").write_text("answer: 42\n")
+    (chart / "templates" / "cm.yaml").write_text(
+        "{{ range . }}unparseable-by-helmlite{{ end }}\n"
+    )
+    (chart / "templates" / "tests" / "t.yaml").write_text("kind: Pod\n")
+    (chart / "crds" / "crd.yaml").write_text("kind: CustomResourceDefinition\n")
+    (chart / ".helmignore").write_text("*.bak\nsecrets/\n")
+    (chart / "notes.bak").write_text("ignored\n")
+    (chart / "secrets").mkdir()
+    (chart / "secrets" / "s.txt").write_text("ignored too\n")
+
+    out_dir = tmp_path / "dist"
+    assert main(["package", "--chart-dir", str(chart), "--out-dir",
+                 str(out_dir)]) == 0
+    with tarfile.open(out_dir / "mychart-1.2.3.tgz") as tar:
+        names = set(tar.getnames())
+    assert "mychart/templates/cm.yaml" in names
+    assert "mychart/templates/tests/t.yaml" in names
+    assert "mychart/crds/crd.yaml" in names
+    assert "mychart/.helmignore" in names
+    assert "mychart/notes.bak" not in names
+    assert not any("secrets" in n for n in names)
+
+
+def test_package_friendly_errors(tmp_path, capsys):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["package", "--chart-dir", str(empty)]) == 1
+    assert "Chart.yaml" in capsys.readouterr().err
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "Chart.yaml").write_text("version: 1\n")  # no name
+    assert main(["package", "--chart-dir", str(bad)]) == 1
+    assert "name and version" in capsys.readouterr().err
+
+
 def test_corpus_random_and_from_tokens(tmp_path, capsys):
     import numpy as np
 
