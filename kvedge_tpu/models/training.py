@@ -40,6 +40,7 @@ def run_training(
     seed: int = 0,
     prepare: Callable = lambda tree: tree,
     mesh=None,
+    on_step: Callable | None = None,
 ) -> TrainResult:
     """Train for ``num_steps`` total, resuming from the latest checkpoint.
 
@@ -49,6 +50,8 @@ def run_training(
     lets callers shard the (restored or fresh) state onto a mesh;
     ``mesh`` is required for the sequence-parallel attention modes
     (``'ring'``/``'ulysses'``; see :func:`make_train_step`).
+    ``on_step(step, loss)`` is called after every completed step — the
+    hook the runtime uses to stream live progress into its heartbeat.
     """
     init_opt, train_step = make_train_step(cfg, optimizer=optimizer, mesh=mesh)
     step = 0
@@ -79,6 +82,8 @@ def run_training(
             params, opt_state, loss = train_step(params, opt_state, batch)
             step += 1
             losses.append(float(loss))
+            if on_step is not None:
+                on_step(step, losses[-1])
             if step % checkpoint_every == 0 or step == num_steps:
                 ckpt.save(step, {"params": params, "opt_state": opt_state})
         return TrainResult(

@@ -59,6 +59,11 @@ class RuntimeHandle:
             # signals — persisted on the state volume across pod
             # generations: the pod-world `systemctl status`.
             "init_events": heartbeat.read_init_events(self.cfg.state_dir),
+            # Live (or last-known) train-payload progress; None unless a
+            # train payload has written it.
+            "train_progress": heartbeat.read_train_progress(
+                self.cfg.state_dir
+            ),
         }
 
     def shutdown(self) -> None:

@@ -56,15 +56,46 @@ def read_init_events(state_dir: str, tail: int = INIT_EVENTS_TAIL) -> list:
     return events
 
 
-def read_heartbeat(state_dir: str) -> dict | None:
-    """Read the last heartbeat, or None if absent/corrupt (fresh volume)."""
-    path = os.path.join(state_dir, HEARTBEAT_FILE)
+def _read_json_doc(path: str) -> dict | None:
+    """One JSON object from ``path``, or None if absent/corrupt."""
     try:
         with open(path, "r", encoding="utf-8") as fh:
             doc = json.load(fh)
     except (OSError, json.JSONDecodeError):
         return None
     return doc if isinstance(doc, dict) else None
+
+
+def _write_json_atomic(path: str, doc: dict, **dump_kwargs) -> None:
+    """tmp + os.replace so readers never observe a half-written file."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, **dump_kwargs)
+    os.replace(tmp, path)
+
+
+def read_heartbeat(state_dir: str) -> dict | None:
+    """Read the last heartbeat, or None if absent/corrupt (fresh volume)."""
+    return _read_json_doc(os.path.join(state_dir, HEARTBEAT_FILE))
+
+
+# Live progress of the `train` payload, written after every step and
+# read back into /status — without it a long training run looks like
+# "booting" until it finishes. On the PVC, so the last known step/loss
+# also survives a crash for post-mortems and the next generation's
+# /status shows where its predecessor got to.
+TRAIN_PROGRESS_FILE = "train-progress.json"
+
+
+def write_train_progress(state_dir: str, doc: dict) -> None:
+    """Atomically persist the latest training progress document."""
+    os.makedirs(state_dir, exist_ok=True)
+    _write_json_atomic(os.path.join(state_dir, TRAIN_PROGRESS_FILE), doc)
+
+
+def read_train_progress(state_dir: str) -> dict | None:
+    """The last persisted progress, or None (absent/corrupt/not training)."""
+    return _read_json_doc(os.path.join(state_dir, TRAIN_PROGRESS_FILE))
 
 
 def write_heartbeat(state_dir: str, payload: dict) -> dict:
@@ -75,11 +106,10 @@ def write_heartbeat(state_dir: str, payload: dict) -> dict:
     doc["ts"] = time.time()
     doc["seq"] = int(previous.get("seq", 0)) + 1
     doc.setdefault("boot_count", int(previous.get("boot_count", 0)))
-    path = os.path.join(state_dir, HEARTBEAT_FILE)
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(doc, fh, indent=2, sort_keys=True)
-    os.replace(tmp, path)
+    _write_json_atomic(
+        os.path.join(state_dir, HEARTBEAT_FILE), doc,
+        indent=2, sort_keys=True,
+    )
     return doc
 
 

@@ -221,11 +221,39 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
         batches = (
             shard_batch(mesh, batch % tcfg.vocab) for batch in feeder
         )
+
+        from kvedge_tpu.runtime import heartbeat
+
+        last_write = 0.0
+
+        def on_step(step: int, loss: float) -> None:
+            # Live progress into /status (and the PVC, so the last known
+            # step/loss survives a crash). Best-effort telemetry:
+            # throttled off the hot loop (always written on the final
+            # step), non-finite losses recorded as null (bare NaN in the
+            # persisted JSON would corrupt every later /status body),
+            # and a failed write must never abort healthy training.
+            nonlocal last_write
+            now = time.time()
+            if step < cfg.train_steps and now - last_write < 1.0:
+                return
+            last_write = now
+            try:
+                heartbeat.write_train_progress(cfg.state_dir, {
+                    "step": step,
+                    "target_steps": cfg.train_steps,
+                    "loss": round(loss, 6) if math.isfinite(loss) else None,
+                    "ts": now,
+                })
+            except OSError:
+                pass
+
         start = time.perf_counter()
         result = run_training(
             tcfg, cfg.state_dir, num_steps=cfg.train_steps,
             batches=batches, checkpoint_every=cfg.train_checkpoint_every,
             prepare=functools.partial(shard_tree, mesh),
+            on_step=on_step,
         )
         elapsed_ms = (time.perf_counter() - start) * 1000.0
     except Exception as e:

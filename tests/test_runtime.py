@@ -366,6 +366,40 @@ def test_train_payload_resumes_across_pod_generations(tmp_path):
         gen2.shutdown()
 
 
+def test_train_payload_streams_progress_to_status(tmp_path):
+    corpus = _write_train_corpus(tmp_path)
+    handle = start_runtime(_cfg(
+        tmp_path, payload="train", train_corpus=corpus, train_steps=3,
+        train_batch=8, train_seq=16, train_checkpoint_every=2,
+    ))
+    try:
+        assert handle.check.ok, handle.check.error
+        code, doc = _get(handle.status_port, "/status")
+        assert code == 200
+        progress = doc["train_progress"]
+        assert progress["step"] == 3 and progress["target_steps"] == 3
+        assert isinstance(progress["loss"], float)
+    finally:
+        handle.shutdown()
+    # The progress file lives on the PVC: a non-train generation booted
+    # against the same volume still shows where training got to.
+    handle = start_runtime(_cfg(tmp_path, payload="none"))
+    try:
+        code, doc = _get(handle.status_port, "/status")
+        assert doc["train_progress"]["step"] == 3
+    finally:
+        handle.shutdown()
+
+
+def test_status_train_progress_absent_is_null(tmp_path):
+    handle = start_runtime(_cfg(tmp_path))
+    try:
+        code, doc = _get(handle.status_port, "/status")
+        assert code == 200 and doc["train_progress"] is None
+    finally:
+        handle.shutdown()
+
+
 def test_train_payload_requires_corpus():
     import pytest
 
