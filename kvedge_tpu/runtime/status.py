@@ -53,6 +53,27 @@ def render_metrics(snapshot: dict) -> str:
         lines.append("# HELP kvedge_devices visible accelerator devices")
         lines.append("# TYPE kvedge_devices gauge")
         lines.append(f"kvedge_devices {check['device_count']}")
+    progress = snapshot.get("train_progress") or {}
+    if progress.get("step") is not None:
+        lines.append("# HELP kvedge_train_step last completed training step")
+        lines.append("# TYPE kvedge_train_step gauge")
+        lines.append(f"kvedge_train_step {progress['step']}")
+    if progress.get("target_steps") is not None:
+        lines.append("# HELP kvedge_train_target_steps training step target")
+        lines.append("# TYPE kvedge_train_target_steps gauge")
+        lines.append(f"kvedge_train_target_steps {progress['target_steps']}")
+    if progress.get("loss") is not None:
+        lines.append("# HELP kvedge_train_loss last training loss")
+        lines.append("# TYPE kvedge_train_loss gauge")
+        lines.append(f"kvedge_train_loss {progress['loss']}")
+    if progress.get("ts") is not None:
+        # Staleness signal: the progress file persists across pod
+        # generations by design, so consumers need the write time to
+        # tell a live run from one that finished long ago.
+        lines.append("# HELP kvedge_train_progress_ts unix time of the "
+                     "last training-progress write")
+        lines.append("# TYPE kvedge_train_progress_ts gauge")
+        lines.append(f"kvedge_train_progress_ts {progress['ts']}")
     return "\n".join(lines) + "\n"
 
 

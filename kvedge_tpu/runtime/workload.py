@@ -170,6 +170,7 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     from kvedge_tpu.models import TransformerConfig
     from kvedge_tpu.models.training import run_training
     from kvedge_tpu.parallel import build_mesh, shard_batch, shard_tree
+    from kvedge_tpu.runtime import heartbeat
     from kvedge_tpu.runtime.checkpoint import StateCheckpointer
 
     axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
@@ -221,8 +222,6 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
         batches = (
             shard_batch(mesh, batch % tcfg.vocab) for batch in feeder
         )
-
-        from kvedge_tpu.runtime import heartbeat
 
         last_write = 0.0
 

@@ -400,6 +400,30 @@ def test_status_train_progress_absent_is_null(tmp_path):
         handle.shutdown()
 
 
+def test_metrics_include_train_progress(tmp_path):
+    from kvedge_tpu.runtime.status import render_metrics
+
+    corpus = _write_train_corpus(tmp_path)
+    handle = start_runtime(_cfg(
+        tmp_path, payload="train", train_corpus=corpus, train_steps=3,
+        train_batch=8, train_seq=16, train_checkpoint_every=2,
+    ))
+    try:
+        body = render_metrics(handle.snapshot())
+        assert "kvedge_train_step 3" in body
+        assert "kvedge_train_target_steps 3" in body
+        assert "kvedge_train_loss " in body
+        assert "kvedge_train_progress_ts " in body  # staleness signal
+    finally:
+        handle.shutdown()
+    # Non-train runtimes simply omit the train gauges.
+    handle = start_runtime(_cfg(tmp_path / "other"))
+    try:
+        assert "kvedge_train_step" not in render_metrics(handle.snapshot())
+    finally:
+        handle.shutdown()
+
+
 def test_train_payload_requires_corpus():
     import pytest
 

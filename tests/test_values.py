@@ -80,3 +80,21 @@ def test_name_override_validated_rfc1123():
         ChartValues(nameOverride="Bad_Name!").validate()
     ChartValues(nameOverride="").validate()  # empty = fall back to chart name
     ChartValues(nameOverride="my-edge-2").validate()
+
+
+def test_readme_values_table_matches_surface():
+    """The reference duplicates its values table in its README (reference
+    README.md:66-73, SURVEY.md §2 #2); ours does too — so the table must
+    list exactly the ChartValues fields or the docs drift."""
+    import dataclasses
+    import pathlib
+
+    readme = (pathlib.Path(__file__).parent.parent / "README.md").read_text()
+    section = readme.split("## Chart values", 1)[1].split("\n## ", 1)[0]
+    documented = {
+        line.split("`")[1]
+        for line in section.splitlines()
+        if line.startswith("| `")
+    }
+    actual = {f.name for f in dataclasses.fields(ChartValues)}
+    assert documented == actual
