@@ -134,6 +134,12 @@ class RuntimeConfig:
 
     name: str = "kvedge-tpu"
     state_dir: str = DEFAULT_STATE_DIR
+    # Where training checkpoints live. "" = <state_dir>/checkpoints on the
+    # per-host PVC (single-host default). Multi-host slices must point
+    # this at storage every host can reach — a shared-filesystem mount or
+    # a remote URI like "gs://bucket/prefix" (resolved by orbax via
+    # etils.epath). Heartbeats always stay on the per-host PVC.
+    checkpoint_dir: str = ""
     heartbeat_interval_s: float = 10.0
     expected_platform: str = "tpu"
     expected_chips: int = 0  # 0 = accept whatever is visible
@@ -141,6 +147,13 @@ class RuntimeConfig:
     distributed: DistributedSpec = DistributedSpec()
     status_port: int = 8476
     status_bind: str = "0.0.0.0"
+    # Bearer token gating the mutating status routes (POST /profile).
+    # Delivered through the runtime-config Secret like the rest of this
+    # TOML, so it never appears in chart values or pod env. "" leaves the
+    # POST surface open — acceptable only when the status port is not
+    # exposed through the LoadBalancer (the GET surface is read-only by
+    # design and stays open either way).
+    status_token: str = ""
     payload: str = "devicecheck"
     # Attention mode for the transformer-probe payload. "" = auto: the
     # ring when the mesh has a seq axis, naive otherwise. Explicit values
@@ -185,6 +198,9 @@ class RuntimeConfig:
             cfg = cls(
                 name=str(runtime.get("name", cls.name)),
                 state_dir=str(runtime.get("state_dir", cls.state_dir)),
+                checkpoint_dir=str(
+                    runtime.get("checkpoint_dir", cls.checkpoint_dir)
+                ),
                 heartbeat_interval_s=float(
                     runtime.get("heartbeat_interval_s", cls.heartbeat_interval_s)
                 ),
@@ -210,6 +226,7 @@ class RuntimeConfig:
                 ),
                 status_port=int(status.get("port", cls.status_port)),
                 status_bind=str(status.get("bind", cls.status_bind)),
+                status_token=str(status.get("token", cls.status_token)),
                 payload=str(payload_doc.get("kind", cls.payload)),
                 payload_attention=str(
                     payload_doc.get("attention", cls.payload_attention)
@@ -280,6 +297,7 @@ class RuntimeConfig:
             "[runtime]\n"
             f"name = {s(self.name)}\n"
             f"state_dir = {s(self.state_dir)}\n"
+            f"checkpoint_dir = {s(self.checkpoint_dir)}\n"
             f"heartbeat_interval_s = {self.heartbeat_interval_s}\n"
             "\n[tpu]\n"
             f"platform = {s(self.expected_platform)}\n"
@@ -294,6 +312,7 @@ class RuntimeConfig:
             "\n[status]\n"
             f"port = {self.status_port}\n"
             f"bind = {s(self.status_bind)}\n"
+            f"token = {s(self.status_token)}\n"
             "\n[payload]\n"
             f"kind = {s(self.payload)}\n"
             f"attention = {s(self.payload_attention)}\n"

@@ -41,6 +41,7 @@ def run_training(
     prepare: Callable = lambda tree: tree,
     mesh=None,
     on_step: Callable | None = None,
+    checkpoint_dir: str = "",
 ) -> TrainResult:
     """Train for ``num_steps`` total, resuming from the latest checkpoint.
 
@@ -52,6 +53,9 @@ def run_training(
     (``'ring'``/``'ulysses'``; see :func:`make_train_step`).
     ``on_step(step, loss)`` is called after every completed step — the
     hook the runtime uses to stream live progress into its heartbeat.
+    ``checkpoint_dir`` redirects checkpoints to shared storage (multi-host
+    slices; see runtime/checkpoint.py) while ``state_dir`` keeps holding
+    the per-host runtime state.
     """
     init_opt, train_step = make_train_step(cfg, optimizer=optimizer, mesh=mesh)
     step = 0
@@ -61,7 +65,7 @@ def run_training(
         params = init_params(jax.random.PRNGKey(seed), cfg)
         return {"params": params, "opt_state": init_opt(params)}
 
-    with StateCheckpointer(state_dir) as ckpt:
+    with StateCheckpointer(state_dir, checkpoint_dir=checkpoint_dir) as ckpt:
         # Abstract template first (zero allocation): materialize a fresh
         # state only when there is nothing to restore, so a resuming pod
         # never holds two full copies of params + optimizer state.

@@ -194,6 +194,7 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
         snapshot=lambda: handle.snapshot(),
         healthy=lambda: handle.check.ok,
         profiler=profile,
+        token=cfg.status_token,
     )
     handle = RuntimeHandle(
         cfg=cfg, check=_booting(), writer=writer, server=server,

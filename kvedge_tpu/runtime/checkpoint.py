@@ -19,17 +19,41 @@ from typing import Any
 CHECKPOINT_SUBDIR = "checkpoints"
 
 
+def resolve_checkpoint_dir(state_dir: str, checkpoint_dir: str = "") -> str:
+    """Where checkpoints live for a given state volume + optional override.
+
+    Default (empty override): ``<state_dir>/checkpoints`` on the PVC —
+    the single-host layout, where checkpoint durability IS pod-restart
+    durability. A multi-host slice needs storage every host can reach
+    (per-host PVCs cannot hold a slice-wide sharded checkpoint), so the
+    override accepts a shared filesystem path or a remote URI
+    (``gs://bucket/prefix`` — orbax resolves URI schemes through
+    ``etils.epath``). URIs are passed through untouched; local paths are
+    absolutized exactly like the default. Heartbeats and train-progress
+    stay on the per-host PVC either way — they are per-pod liveness
+    state, not slice state.
+    """
+    if not checkpoint_dir:
+        return os.path.abspath(os.path.join(state_dir, CHECKPOINT_SUBDIR))
+    if "://" in checkpoint_dir:
+        return checkpoint_dir
+    return os.path.abspath(checkpoint_dir)
+
+
 class StateCheckpointer:
     """Thin orbax CheckpointManager over the state volume.
 
     Synchronous by design: the runtime's value proposition is that state
     is on the PVC when the pod dies, so every save waits for durability.
+    ``checkpoint_dir`` overrides the on-PVC default for shared-storage
+    deployments (see :func:`resolve_checkpoint_dir`).
     """
 
-    def __init__(self, state_dir: str, keep: int = 3):
+    def __init__(self, state_dir: str, keep: int = 3,
+                 checkpoint_dir: str = ""):
         import orbax.checkpoint as ocp
 
-        self._dir = os.path.abspath(os.path.join(state_dir, CHECKPOINT_SUBDIR))
+        self._dir = resolve_checkpoint_dir(state_dir, checkpoint_dir)
         self._manager = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),

@@ -8,10 +8,20 @@ the full runtime picture (devices, mesh, heartbeat age, boot count),
 ``/metrics`` in Prometheus text format, ``/version`` for kubelet probes,
 and ``POST /profile?seconds=N`` for an on-demand profiler trace capture
 (``kvedge_tpu/runtime/profiling.py``).
+
+Auth model: the GET surface is read-only by design and stays open (the
+reference's only public surface, SSH, is key-gated; the pod-world /status
+is the ``kubectl get vmi`` analogue and leaks no secrets). The one
+*mutating* route, ``POST /profile``, triggers device work and writes to
+the state volume, so when the runtime config carries ``[status] token``
+(delivered through the same boot-config Secret as the rest of the TOML)
+the POST requires ``Authorization: Bearer <token>`` and answers 401
+otherwise.
 """
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -82,30 +92,38 @@ class StatusServer:
 
     ``snapshot`` supplies the /status document; ``healthy`` is a cheap
     in-memory check for /healthz (liveness probes hit it every few seconds,
-    so it must not touch the state volume).
+    so it must not touch the state volume). A non-empty ``token`` gates
+    every mutating (POST) route behind ``Authorization: Bearer <token>``;
+    the read-only GET surface is never gated.
     """
 
     def __init__(self, bind: str, port: int, snapshot: Callable[[], dict],
                  healthy: Callable[[], bool] | None = None,
-                 profiler: Callable[[float], dict] | None = None):
+                 profiler: Callable[[float], dict] | None = None,
+                 token: str = ""):
         outer = self
         self._healthy = healthy or (
             lambda: bool(snapshot().get("ok", False))
         )
         self._profiler = profiler
+        self._token = token
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet by default
                 pass
 
-            def _send(self, code: int, doc: dict) -> None:
+            def _send(self, code: int, doc: dict,
+                      extra_headers: dict | None = None) -> None:
                 body = json.dumps(doc, indent=2, sort_keys=True).encode()
-                self._send_raw(code, body, "application/json")
+                self._send_raw(code, body, "application/json", extra_headers)
 
-            def _send_raw(self, code: int, body: bytes, ctype: str) -> None:
+            def _send_raw(self, code: int, body: bytes, ctype: str,
+                          extra_headers: dict | None = None) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (extra_headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -131,10 +149,39 @@ class StatusServer:
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
+            def _authorized(self) -> bool:
+                """Bearer-token check for mutating routes.
+
+                Constant-time comparison; an unset token leaves the POST
+                surface open (dev/local use; any deployment that enables
+                the LoadBalancer should set ``[status] token`` in the
+                runtime config TOML — see config/runtime_config.py).
+                """
+                if not outer._token:
+                    return True
+                auth = self.headers.get("Authorization", "")
+                scheme, _, presented = auth.partition(" ")
+                # Compare as bytes: compare_digest on str raises TypeError
+                # for non-ASCII input, and headers arrive latin-1-decoded,
+                # so an attacker-supplied high byte would otherwise kill
+                # the handler thread instead of getting a 401.
+                return scheme.lower() == "bearer" and hmac.compare_digest(
+                    presented.strip().encode("utf-8", "surrogateescape"),
+                    outer._token.encode("utf-8"),
+                )
+
             def do_POST(self):
                 url = urlsplit(self.path)
                 if url.path != "/profile":
                     self._send(404, {"error": f"no route {url.path}"})
+                    return
+                if not self._authorized():
+                    self._send(
+                        401,
+                        {"error": "POST /profile requires Authorization: "
+                                  "Bearer <status token>"},
+                        extra_headers={"WWW-Authenticate": "Bearer"},
+                    )
                     return
                 if outer._profiler is None:
                     self._send(503, {"error": "profiler not available"})

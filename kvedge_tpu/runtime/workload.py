@@ -208,7 +208,9 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     try:
         # Peek the resume point first: the feeder must start at the
         # batch the restored step would consume next.
-        with StateCheckpointer(cfg.state_dir) as ckpt:
+        with StateCheckpointer(
+            cfg.state_dir, checkpoint_dir=cfg.checkpoint_dir
+        ) as ckpt:
             resume_step = ckpt.latest_step() or 0
         feeder = open_feeder(
             cfg.train_corpus, batch=cfg.train_batch, seq=cfg.train_seq,
@@ -252,7 +254,7 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             tcfg, cfg.state_dir, num_steps=cfg.train_steps,
             batches=batches, checkpoint_every=cfg.train_checkpoint_every,
             prepare=functools.partial(shard_tree, mesh),
-            on_step=on_step,
+            on_step=on_step, checkpoint_dir=cfg.checkpoint_dir,
         )
         elapsed_ms = (time.perf_counter() - start) * 1000.0
     except Exception as e:

@@ -1,5 +1,7 @@
 """Checkpoint/resume through the state volume (orbax layout)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -8,7 +10,10 @@ import pytest
 
 from kvedge_tpu.models import TransformerConfig
 from kvedge_tpu.models.training import run_training
-from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+from kvedge_tpu.runtime.checkpoint import (
+    StateCheckpointer,
+    resolve_checkpoint_dir,
+)
 
 TINY = TransformerConfig(
     vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=16
@@ -72,6 +77,54 @@ def test_training_resumes_across_crash(tmp_path):
         TINY, state, num_steps=10, batches=_batches(), optimizer=opt,
     )
     assert third.step == 10 and third.losses == []
+
+
+def test_resolve_checkpoint_dir_defaults_to_pvc(tmp_path):
+    assert resolve_checkpoint_dir(str(tmp_path)) == str(
+        tmp_path / "checkpoints"
+    )
+
+
+def test_resolve_checkpoint_dir_passes_uris_untouched():
+    """gs://-style URIs must not be absolutized into local paths —
+    os.path.abspath("gs://b/p") would yield "<cwd>/gs:/b/p" and the
+    checkpointer would silently write to the local disk instead of the
+    bucket every host shares."""
+    uri = "gs://my-bucket/checkpoints/run-1"
+    assert resolve_checkpoint_dir("/var/lib/kvedge/state", uri) == uri
+
+
+def test_resolve_checkpoint_dir_absolutizes_local_override(tmp_path):
+    rel = os.path.relpath(str(tmp_path / "shared"))
+    assert resolve_checkpoint_dir(str(tmp_path), rel) == str(
+        tmp_path / "shared"
+    )
+
+
+def test_shared_checkpoint_dir_resumes_across_state_volumes(tmp_path):
+    """The multi-host story: checkpoints on shared storage, per-host PVCs.
+
+    Generation 1 trains against PVC A; the pod is rescheduled onto a node
+    with a DIFFERENT (fresh) PVC B — with checkpoints on shared storage
+    the run still resumes, which the on-PVC default could never do.
+    """
+    shared = str(tmp_path / "shared-ckpt")
+    opt = optax.adam(1e-2)
+
+    first = run_training(
+        TINY, str(tmp_path / "pvc-a"), num_steps=5, batches=_batches(),
+        optimizer=opt, checkpoint_every=5, checkpoint_dir=shared,
+    )
+    assert first.step == 5
+    # Nothing landed on the PVC's default checkpoint location.
+    assert not (tmp_path / "pvc-a" / "checkpoints").exists()
+
+    second = run_training(
+        TINY, str(tmp_path / "pvc-b"), num_steps=10, batches=_batches(),
+        optimizer=opt, checkpoint_every=5, checkpoint_dir=shared,
+    )
+    assert second.resumed_from == 5 and second.step == 10
+    assert second.losses[0] < first.losses[0]
 
 
 def test_training_unused_batches_not_consumed(tmp_path):

@@ -106,8 +106,8 @@ def test_concurrent_capture_is_refused(tmp_path):
 # ---- HTTP route ----------------------------------------------------------
 
 
-def _post(url: str) -> tuple[int, dict]:
-    req = urllib.request.Request(url, method="POST")
+def _post(url: str, headers: dict | None = None) -> tuple[int, dict]:
+    req = urllib.request.Request(url, method="POST", headers=headers or {})
     try:
         with urllib.request.urlopen(req, timeout=30) as resp:
             return resp.status, json.loads(resp.read())
@@ -156,6 +156,86 @@ def test_post_profile_bad_seconds_is_400(server):
         f"http://127.0.0.1:{server.port}/profile?seconds=abc"
     )
     assert code == 400
+
+
+# ---- Bearer-token gate (VERDICT r1 weak #4) ------------------------------
+#
+# The status port rides the same LoadBalancer as SSH, so the one mutating
+# route must not be world-callable: with [status] token set, POST /profile
+# answers 401 without the right Authorization header. The read-only GET
+# surface stays open by design.
+
+
+@pytest.fixture
+def gated_server(tmp_path):
+    cap = TraceCapture(str(tmp_path))
+    srv = StatusServer(
+        "127.0.0.1", 0, snapshot=lambda: {"ok": True},
+        profiler=cap.capture, token="sekrit-tok",
+    )
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_unauthenticated_post_profile_is_401(gated_server, tmp_path):
+    code, doc = _post(
+        f"http://127.0.0.1:{gated_server.port}/profile?seconds=0.1"
+    )
+    assert code == 401
+    assert "Bearer" in doc["error"]
+    assert not (tmp_path / "traces").exists()  # nothing captured
+
+
+def test_wrong_token_post_profile_is_401(gated_server):
+    code, _ = _post(
+        f"http://127.0.0.1:{gated_server.port}/profile?seconds=0.1",
+        headers={"Authorization": "Bearer wrong"},
+    )
+    assert code == 401
+
+
+def test_non_ascii_token_post_profile_is_401_not_crash(gated_server):
+    # Headers decode as latin-1; a high byte must yield a clean 401
+    # (str-vs-str compare_digest would raise TypeError and kill the
+    # handler thread with no HTTP response at all).
+    code, _ = _post(
+        f"http://127.0.0.1:{gated_server.port}/profile?seconds=0.1",
+        headers={"Authorization": "Bearer sekr\xedt"},
+    )
+    assert code == 401
+
+
+def test_bearer_token_post_profile_succeeds(gated_server, tmp_path):
+    code, doc = _post(
+        f"http://127.0.0.1:{gated_server.port}/profile?seconds=0.1",
+        headers={"Authorization": "Bearer sekrit-tok"},
+    )
+    assert code == 200
+    assert doc["files"] > 0
+
+
+def test_gated_server_get_surface_stays_open(gated_server):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{gated_server.port}/status", timeout=10
+    ) as resp:
+        assert resp.status == 200
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{gated_server.port}/healthz", timeout=10
+    ) as resp:
+        assert resp.status == 200
+
+
+def test_status_token_round_trips_through_config_toml():
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+
+    cfg = RuntimeConfig.parse(
+        '[status]\nport = 0\ntoken = "tok-from-secret"\n'
+    )
+    assert cfg.status_token == "tok-from-secret"
+    assert RuntimeConfig.parse(cfg.to_toml()).status_token == (
+        "tok-from-secret"
+    )
 
 
 def test_post_profile_while_booting_is_503(tmp_path):

@@ -28,23 +28,27 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--attention", nargs="*", default=["naive", "flash"])
-    ap.add_argument("--batch", nargs="*", type=int, default=[16, 32, 64])
-    ap.add_argument("--remat", nargs="*", type=int, default=[1, 0])
+    ap.add_argument("--batch", nargs="*", type=int, default=[32, 64, 128])
+    # remat modes: "off", "full", "dots" (off = no checkpointing at all).
+    ap.add_argument("--remat", nargs="*", default=["off", "full", "dots"])
     args = ap.parse_args()
 
     results = []
     for attn, remat, bpd in itertools.product(
         args.attention, args.remat, args.batch
     ):
-        cfg = dataclasses.replace(FLAGSHIP, attention=attn, remat=bool(remat))
+        cfg = dataclasses.replace(
+            FLAGSHIP, attention=attn, remat=remat != "off",
+            remat_policy=remat if remat != "off" else "full",
+        )
         try:
             tps, loss, _ = measure(cfg, bpd, args.seq, args.steps)
         except Exception as e:  # OOM etc — report and keep sweeping
-            print(f"attn={attn:5s} remat={remat} bpd={bpd:3d}  FAILED: "
+            print(f"attn={attn:5s} remat={remat:4s} bpd={bpd:3d}  FAILED: "
                   f"{type(e).__name__}: {str(e)[:120]}", flush=True)
             continue
         results.append((tps, attn, remat, bpd))
-        print(f"attn={attn:5s} remat={remat} bpd={bpd:3d}  "
+        print(f"attn={attn:5s} remat={remat:4s} bpd={bpd:3d}  "
               f"{tps:10.0f} tok/s  loss={loss:.3f}", flush=True)
 
     if results:
