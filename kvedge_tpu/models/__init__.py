@@ -12,6 +12,7 @@ from kvedge_tpu.models.transformer import (
     TransformerConfig,
     init_params,
     forward,
+    forward_hidden,
     forward_with_aux,
     loss_fn,
     make_train_step,
@@ -29,6 +30,7 @@ __all__ = [
     "TransformerConfig",
     "init_params",
     "forward",
+    "forward_hidden",
     "forward_with_aux",
     "loss_fn",
     "make_train_step",

@@ -75,6 +75,16 @@ class TransformerConfig:
     # Microbatches per step under pipelining; 0 = one per stage. More
     # microbatches shrink the pipeline bubble (M / (M + S - 1)).
     pipeline_microbatches: int = 0
+    # Fused cross-entropy readout (ops/xent.py): the training loss skips
+    # materializing [B*T, V] logits entirely — blockwise Pallas matmuls
+    # with an online logsumexp and an LSE-recompute backward. Measured on
+    # v5e the fp32 logits tensor (4.2 GB at the bench shape) and its
+    # cotangent dominated the step's HBM traffic. Inference paths
+    # (forward/decode) still materialize logits — they need them.
+    # Requires vocab % 128 == 0 and batch*seq % 8 == 0; does not compose
+    # with tensor-parallel ('model' > 1) meshes yet — the D contraction
+    # would need a psum before the online softmax.
+    fused_xent: bool = False
     # "naive" materializes [T, T] scores (XLA-fused); "flash" streams K/V
     # blocks through a Pallas kernel with an online softmax (no [T, T] in
     # forward); "ring" shards the sequence over the mesh's ``seq`` axis
@@ -94,12 +104,16 @@ class TransformerConfig:
     @property
     def needs_mesh(self) -> bool:
         """True when the concrete mesh is required at trace time: the
-        sequence-parallel and pipeline shard_maps, and the MoE layer's
+        sequence-parallel and pipeline shard_maps, the MoE layer's
         expert-placement ``with_sharding_constraint`` (without which XLA
-        may replicate the experts). Callers pass ``mesh`` to
-        :func:`forward`/:func:`make_train_step` iff this is set."""
+        may replicate the experts), and the fused cross-entropy kernel
+        (its shard_map over the data axis, and the tensor-parallel
+        rejection — without the mesh the guard could never fire).
+        Callers pass ``mesh`` to :func:`forward`/:func:`make_train_step`
+        iff this is set."""
         return (self.attention in ("ring", "ulysses")
-                or self.n_experts > 0 or self.pipeline_stages > 1)
+                or self.n_experts > 0 or self.pipeline_stages > 1
+                or self.fused_xent)
 
     @property
     def kv_heads(self) -> int:
@@ -358,14 +372,19 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
     return x, aux
 
 
-def forward_with_aux(params: dict, tokens, cfg: TransformerConfig,
-                     mesh=None):
-    """tokens [B, T] int32 -> (logits [B, T, V] fp32, aux scalar fp32).
+def forward_hidden(params: dict, tokens, cfg: TransformerConfig,
+                   mesh=None):
+    """tokens [B, T] int32 -> (hidden [B, T, D] compute-dtype, aux fp32).
+
+    The transformer stack up to and including the final RMSNorm — i.e.
+    everything except the readout matmul. Split out so the training loss
+    can feed the hidden states straight into the fused cross-entropy
+    kernel (ops/xent.py) without logits ever materializing; the inference
+    paths apply :func:`tied_readout` on top via :func:`forward_with_aux`.
 
     ``aux`` is the mean per-layer MoE load-balancing loss (0.0 for dense
-    configs); ``loss_fn`` folds it into the training objective. ``mesh``
-    is only needed for the sequence-parallel attention modes
-    (``'ring'``/``'ulysses'``); when given, activations are pinned
+    configs). ``mesh`` is only needed for the sequence-parallel attention
+    modes (``'ring'``/``'ulysses'``); when given, activations are pinned
     seq-sharded between layers so the LN/MLP work stays sequence-parallel
     too.
     """
@@ -402,8 +421,7 @@ def forward_with_aux(params: dict, tokens, cfg: TransformerConfig,
             remat_policy=_remat_policy(cfg),
         )
         aux = jnp.zeros((), jnp.float32)  # pipeline excludes MoE (validate)
-        x = _rmsnorm(x, params["ln_final"])
-        return tied_readout(x, embedding), aux
+        return _rmsnorm(x, params["ln_final"]), aux
 
     def body(carry, layer_params):
         out, aux = _layer(cfg, carry, layer_params, mesh)
@@ -414,8 +432,18 @@ def forward_with_aux(params: dict, tokens, cfg: TransformerConfig,
     if cfg.remat:
         body = jax.checkpoint(body, policy=_remat_policy(cfg))
     x, aux_per_layer = lax.scan(body, x, stacked)
-    x = _rmsnorm(x, params["ln_final"])
-    return tied_readout(x, embedding), jnp.mean(aux_per_layer)
+    return _rmsnorm(x, params["ln_final"]), jnp.mean(aux_per_layer)
+
+
+def forward_with_aux(params: dict, tokens, cfg: TransformerConfig,
+                     mesh=None):
+    """tokens [B, T] int32 -> (logits [B, T, V] fp32, aux scalar fp32).
+
+    See :func:`forward_hidden` for the mesh/aux semantics; this applies
+    the weight-tied readout on top.
+    """
+    x, aux = forward_hidden(params, tokens, cfg, mesh)
+    return tied_readout(x, params["embedding"]), aux
 
 
 def forward(params: dict, tokens, cfg: TransformerConfig, mesh=None):
@@ -428,19 +456,73 @@ def forward(params: dict, tokens, cfg: TransformerConfig, mesh=None):
     return logits
 
 
+def _fused_xent_loss(params: dict, inputs, targets,
+                     cfg: TransformerConfig, mesh=None):
+    """Training CE via the Pallas fused readout kernel (ops/xent.py).
+
+    Hidden states go straight into blockwise logsumexp/target-logit
+    kernels — the [B, T, V] logits tensor never exists in either pass.
+    Mesh handling (``needs_mesh`` guarantees the mesh reaches here
+    whenever fused_xent is on):
+
+    * ``model`` axis > 1 — rejected: the D contraction would need a psum
+      before the online softmax.
+    * ``data`` axis > 1 — the kernel runs under ``shard_map`` over the
+      batch rows (embedding replicated); without it XLA cannot partition
+      an opaque custom call and would gather the full batch per device.
+    * single-device meshes (and mesh=None from non-training callers) run
+      the kernel directly.
+    """
+    from kvedge_tpu.ops.xent import fused_xent
+
+    interpret = jax.default_backend() != "tpu"  # interpret kernels off-TPU
+    hidden, aux = forward_hidden(params, inputs, cfg, mesh)
+    b, t, d = hidden.shape
+    rows = hidden.reshape(b * t, d)
+    flat_targets = targets.reshape(b * t)
+
+    axis_sizes = dict(mesh.shape) if mesh is not None else {}
+    if axis_sizes.get("model", 1) > 1:
+        raise ValueError(
+            "fused_xent does not compose with tensor parallelism "
+            "('model' axis > 1): the D contraction would need a psum "
+            "before the online softmax; disable fused_xent"
+        )
+    if axis_sizes.get("data", 1) > 1:
+        from jax.sharding import PartitionSpec as P
+
+        # check_vma off: pallas_call out_shapes don't declare mesh-axis
+        # variance, which the checker would otherwise require.
+        per_row = jax.shard_map(
+            lambda x, e, tg: fused_xent(x, e, tg, interpret),
+            mesh=mesh,
+            in_specs=(P("data", None), P(), P("data")),
+            out_specs=P("data"),
+            check_vma=False,
+        )(rows, params["embedding"], flat_targets)
+    else:
+        per_row = fused_xent(rows, params["embedding"], flat_targets,
+                             interpret)
+    return jnp.mean(per_row), aux
+
+
 def loss_fn(params: dict, batch, cfg: TransformerConfig, mesh=None):
     """Next-token cross-entropy. batch [B, T] int32; targets are shifted."""
     inputs = batch[:, :-1]
     targets = batch[:, 1:]
-    logits, aux = forward_with_aux(params, inputs, cfg, mesh)
-    # Fused cross-entropy: logsumexp(logits) - logits[target] needs only
-    # two [B, T] reductions over the vocab axis, instead of materializing a
-    # second [B, T, V] fp32 log-probs tensor (which at vocab=32000 would be
-    # the largest buffer in the step).
-    target_logit = jnp.take_along_axis(
-        logits, targets[..., None], axis=-1
-    )[..., 0]
-    ce = jnp.mean(jax.nn.logsumexp(logits, axis=-1) - target_logit)
+    if cfg.fused_xent:
+        ce, aux = _fused_xent_loss(params, inputs, targets, cfg, mesh)
+    else:
+        logits, aux = forward_with_aux(params, inputs, cfg, mesh)
+        # Fused cross-entropy (XLA level): logsumexp(logits) -
+        # logits[target] needs only two [B, T] reductions over the vocab
+        # axis, instead of materializing a second [B, T, V] fp32
+        # log-probs tensor (which at vocab=32000 would be the largest
+        # buffer in the step).
+        target_logit = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1
+        )[..., 0]
+        ce = jnp.mean(jax.nn.logsumexp(logits, axis=-1) - target_logit)
     if cfg.n_experts:
         # Router load balancing: without it, top-1 routing collapses onto
         # a few experts and the rest never train.

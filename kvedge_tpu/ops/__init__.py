@@ -7,5 +7,6 @@ kernel takes over.
 """
 
 from kvedge_tpu.ops.attention import flash_attention
+from kvedge_tpu.ops.xent import fused_xent
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fused_xent"]

@@ -7,20 +7,27 @@ measured 26x slower than this kernel on v5e (HBM thrash). The kernel
 streams K/V blocks with an online softmax (running max + denominator), so
 peak VMEM is O(block²) regardless of context length.
 
-Structure (canonical TPU flash layout): grid = (batch*heads, q_blocks,
-k_blocks) with the k dimension innermost. TPU grids execute sequentially,
-so VMEM scratch (running max / denominator / accumulator) carries state
+Structure (canonical TPU flash layout, plus head grouping): grid =
+(batch*heads/G, q_blocks, k_blocks) with the k dimension innermost and G
+heads processed per program as a batched dot_general. Grouping exists
+because of a measurement: at T=512 a one-head-per-program grid is 512
+sequential programs of tiny matmuls, and the kernel lost to XLA's naive
+path on per-program overhead alone. TPU grids execute sequentially, so
+VMEM scratch (running max / denominator / accumulator) carries state
 across the k iterations of one q block; the output block is written on the
 last k step. Causal blocks above the diagonal are skipped with ``pl.when``
-(no wasted MXU work). Matmuls request ``preferred_element_type=float32`` so
-the MXU accumulates in fp32.
+(no wasted MXU work — which also argues for blocks smaller than T: at
+block == T the single program computes the full masked matrix). Matmul
+operands stay in the input dtype (bf16 in training — fp32 operands run at
+a fraction of the MXU's bf16 rate) and request
+``preferred_element_type=float32`` so accumulation is fp32.
 
 Backward: custom VJP, also blockwise Pallas — two passes that recompute
 probabilities from the saved log-sum-exp (never materializing [T, T]):
 a dq pass (grid q-major, k innermost, accumulating dq in VMEM scratch) and
 a dk/dv pass (grid k-major, q innermost, accumulating dk/dv). The per-row
 ``delta = rowsum(dO * O)`` is a cheap fused elementwise reduce left to XLA.
-Peak memory in backward is therefore O(block²) as well, so long-context
+Peak memory in backward is therefore O(G·block²) as well, so long-context
 training no longer relies on remat to keep one dense [T, T] per layer.
 """
 
@@ -33,22 +40,24 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK = 512
+DEFAULT_BLOCK = 256
 
 
 def pick_block(seq: int) -> int:
     """Largest hardware-aligned block that divides ``seq``.
 
-    Measured on v5e (T=8192, warm, median of 5): block 512/256 ≈ 27 ms
-    forward, block 128 ≈ 44 ms — small blocks are grid-overhead-bound, and
-    block 1024's score tile starts pressuring VMEM (2048 exceeds the 16 MB
-    scoped limit). Hence the preference order below.
+    256 leads the preference order: it matched 512 on long-context
+    throughput (T=8192, v5e, median of 5) while letting the causal
+    ``pl.when`` skip real work at short T (at block 512 == T the whole
+    masked upper triangle is computed anyway), and its score tiles leave
+    VMEM room for head grouping. Block 128 was grid-overhead-bound and
+    1024 pressured the ~16 MB scoped VMEM limit.
 
     Raises (at trace time, with an actionable message) when no aligned
     block divides the sequence, rather than silently running a different
     attention path than the one configured.
     """
-    for block in (DEFAULT_BLOCK, 256, 128, 64, 32, 16, 8):
+    for block in (DEFAULT_BLOCK, 512, 128, 64, 32, 16, 8):
         if seq % block == 0:
             return block
     raise ValueError(
@@ -58,16 +67,37 @@ def pick_block(seq: int) -> int:
     )
 
 
+def pick_heads_per_program(bh: int, block: int, dh: int,
+                           live_tiles: int = 4) -> int:
+    """Heads (batch*head rows) each kernel program processes.
+
+    Bounded by a ~12 MB working-set budget inside the ~16 MB scoped VMEM:
+    ``live_tiles`` counts the [G, block, block] fp32 intermediates a
+    kernel keeps live at once (s/p in forward; s/p/dp/ds in backward),
+    plus the [G, block, dh] input/accumulator blocks and double-buffered
+    DMA. Grouping amortizes per-program overhead — the difference between
+    this kernel losing and winning at short sequence lengths.
+    """
+    budget = 12 * 1024 * 1024
+    for g in (16, 8, 4, 2, 1):
+        if bh % g:
+            continue
+        tiles = live_tiles * g * block * block * 4
+        blocks = 8 * g * block * dh * 2 + 2 * g * block * dh * 4
+        if tiles + blocks <= budget:
+            return g
+    return 1
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scratch, l_scratch, acc_scratch, *, block: int,
                 scale: float):
-    """One (bh, qi, ki) step: fold k block ki into q block qi's running state.
+    """One (g, qi, ki) step: fold k block ki into q block qi's running state.
 
-    q_ref: [1, block, dh]; k_ref/v_ref: [1, block, dh];
-    o_ref: [1, block, dh]; lse_ref: [1, block, 1] (trailing singleton keeps
-    the block's last two dims on the (8, 128) tiling rule);
-    scratches: m/l [block, 1], acc [block, dh] — persist across the
-    sequential k grid dimension.
+    q_ref/k_ref/v_ref: [G, block, dh]; o_ref: [G, block, dh];
+    lse_ref: [G, block, 1] (trailing singleton keeps the block's last two
+    dims on the (8, 128) tiling rule); scratches: m/l [G, block, 1],
+    acc [G, block, dh] — persist across the sequential k grid dimension.
     """
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -82,21 +112,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # Causal: q block qi sees k blocks 0..qi only (block_q == block_k).
     @pl.when(ki <= qi)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale  # [bq, dh]
-        kj = k_ref[0].astype(jnp.float32)
-        vj = v_ref[0].astype(jnp.float32)
+        q = q_ref[...]  # [G, bq, dh]
+        kj = k_ref[...]
+        vj = v_ref[...]
         s = jax.lax.dot_general(
             q, kj,
-            dimension_numbers=(((1,), (1,)), ((), ())),
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # [bq, bk]
+        ) * scale  # [G, bq, bk]
         row_ids = qi * block + jax.lax.broadcasted_iota(
             jnp.int32, (block, block), 0
         )
         col_ids = ki * block + jax.lax.broadcasted_iota(
             jnp.int32, (block, block), 1
         )
-        s = jnp.where(col_ids <= row_ids, s, -jnp.inf)
+        s = jnp.where((col_ids <= row_ids)[None], s, -jnp.inf)
 
         m_prev = m_scratch[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -107,15 +137,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             p, axis=-1, keepdims=True
         )
         acc_scratch[:] = acc_scratch[:] * correction + jax.lax.dot_general(
-            p, vj,
-            dimension_numbers=(((1,), (0,)), ((), ())),
+            p.astype(vj.dtype), vj,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
 
     @pl.when(ki == nk - 1)
     def _():
-        o_ref[0] = (acc_scratch[:] / l_scratch[:]).astype(o_ref.dtype)
-        lse_ref[0] = m_scratch[:] + jnp.log(l_scratch[:])
+        o_ref[...] = (acc_scratch[:] / l_scratch[:]).astype(o_ref.dtype)
+        lse_ref[...] = m_scratch[:] + jnp.log(l_scratch[:])
 
 
 def _flash_fwd_raw(q, k, v, *, block: int, interpret: bool):
@@ -125,28 +155,27 @@ def _flash_fwd_raw(q, k, v, *, block: int, interpret: bool):
         raise ValueError(f"seq {seq} must be a multiple of block {block}")
     scale = dh ** -0.5
     nblk = seq // block
-    grid = (bh, nblk, nblk)
+    g = pick_heads_per_program(bh, block, dh, live_tiles=2)
+    grid = (bh // g, nblk, nblk)
     kernel = functools.partial(_fwd_kernel, block=block, scale=scale)
+    head_blk = pl.BlockSpec((g, block, dh), lambda b, i, j: (b, i, 0))
+    kv_blk = pl.BlockSpec((g, block, dh), lambda b, i, j: (b, j, 0))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block, dh), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block, dh), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block, dh), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=[head_blk, kv_blk, kv_blk],
         out_specs=[
-            pl.BlockSpec((1, block, dh), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block, 1), lambda b, i, j: (b, i, 0)),
+            head_blk,
+            pl.BlockSpec((g, block, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, dh), q.dtype),
             jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block, 1), jnp.float32),
-            pltpu.VMEM((block, 1), jnp.float32),
-            pltpu.VMEM((block, dh), jnp.float32),
+            pltpu.VMEM((g, block, 1), jnp.float32),
+            pltpu.VMEM((g, block, 1), jnp.float32),
+            pltpu.VMEM((g, block, dh), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -174,34 +203,34 @@ def _flash_fwd_vjp(q, k, v, block, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _recompute_p(q_scaled, kj, lse, qi, ki, block):
+def _recompute_p(q, kj, lse, qi, ki, block, scale):
     """Rebuild this block's softmax probabilities from the saved LSE.
 
-    Masked (non-causal) entries get s = -inf, hence p = 0 exactly — the
+    Same bf16-operand matmul + scale-after as the forward, so the
     recompute is numerically identical to the forward's final state.
+    Masked (non-causal) entries get s = -inf, hence p = 0 exactly.
     """
     s = jax.lax.dot_general(
-        q_scaled, kj,
-        dimension_numbers=(((1,), (1,)), ((), ())),
+        q, kj,
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
-    )  # [bq, bk]
+    ) * scale  # [G, bq, bk]
     row_ids = qi * block + jax.lax.broadcasted_iota(
         jnp.int32, (block, block), 0
     )
     col_ids = ki * block + jax.lax.broadcasted_iota(
         jnp.int32, (block, block), 1
     )
-    s = jnp.where(col_ids <= row_ids, s, -jnp.inf)
+    s = jnp.where((col_ids <= row_ids)[None], s, -jnp.inf)
     return jnp.exp(s - lse)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    acc_scratch, *, block: int, scale: float):
-    """One (bh, qi, ki) step: fold k block ki into q block qi's dq.
+    """One (g, qi, ki) step: fold k block ki into q block qi's dq.
 
-    ds = p * (dp - delta); dq_block = scale * sum_k ds @ K_k. The q operand
-    is pre-scaled (matching the forward), so the trailing multiply by
-    ``scale`` finishes dq exactly once.
+    ds = p * (dp - delta); dq_block = scale * sum_k ds @ K_k (one factor
+    of ``scale`` from s = scale * q k^T, applied once at the final write).
     """
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -213,35 +242,36 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(ki <= qi)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale
-        kj = k_ref[0].astype(jnp.float32)
-        vj = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        p = _recompute_p(q, kj, lse_ref[0], qi, ki, block)
+        q = q_ref[...]
+        kj = k_ref[...]
+        vj = v_ref[...]
+        do = do_ref[...]
+        p = _recompute_p(q, kj, lse_ref[...], qi, ki, block, scale)
         dp = jax.lax.dot_general(
             do, vj,
-            dimension_numbers=(((1,), (1,)), ((), ())),
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        ds = p * (dp - delta_ref[0])
+        )  # [G, bq, bk]
+        ds = p * (dp - delta_ref[...])
         acc_scratch[:] += jax.lax.dot_general(
-            ds, kj,
-            dimension_numbers=(((1,), (0,)), ((), ())),
+            ds.astype(kj.dtype), kj,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
 
     @pl.when(ki == nk - 1)
     def _():
-        dq_ref[0] = (acc_scratch[:] * scale).astype(dq_ref.dtype)
+        dq_ref[...] = (acc_scratch[:] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scratch, dv_scratch, *, block: int,
                     scale: float):
-    """One (bh, ki, qi) step: fold q block qi into k block ki's dk/dv.
+    """One (g, ki, qi) step: fold q block qi into k block ki's dk/dv.
 
-    dv_block = sum_q P^T @ dO_q; dk_block = sum_q dS^T @ (scale * Q_q)
-    (the pre-scaled q already carries the 1/sqrt(dh)).
+    dv_block = sum_q P^T @ dO_q; dk_block = scale * sum_q dS^T @ Q_q
+    (the 1/sqrt(dh) from s = scale * q k^T, applied once at the final
+    write).
     """
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -254,32 +284,32 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi >= ki)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale
-        kj = k_ref[0].astype(jnp.float32)
-        vj = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        p = _recompute_p(q, kj, lse_ref[0], qi, ki, block)  # [bq, bk]
+        q = q_ref[...]
+        kj = k_ref[...]
+        vj = v_ref[...]
+        do = do_ref[...]
+        p = _recompute_p(q, kj, lse_ref[...], qi, ki, block, scale)
         dv_scratch[:] += jax.lax.dot_general(
-            p, do,
-            dimension_numbers=(((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do,
+            dimension_numbers=(((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # [bk, dh]
+        )  # [G, bk, dh]
         dp = jax.lax.dot_general(
             do, vj,
-            dimension_numbers=(((1,), (1,)), ((), ())),
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        ds = p * (dp - delta_ref[0])
+        )  # [G, bq, bk]
+        ds = p * (dp - delta_ref[...])
         dk_scratch[:] += jax.lax.dot_general(
-            ds, q,
-            dimension_numbers=(((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )  # [bk, dh]
+        )  # [G, bk, dh]
 
     @pl.when(qi == nq - 1)
     def _():
-        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+        dk_ref[...] = (dk_scratch[:] * scale).astype(dk_ref.dtype)
+        dv_ref[...] = dv_scratch[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd_vjp(block, interpret, residuals, g):
@@ -289,6 +319,7 @@ def _flash_bwd_vjp(block, interpret, residuals, g):
     bh, seq, dh = q.shape
     scale = dh ** -0.5
     nblk = seq // block
+    gh = pick_heads_per_program(bh, block, dh, live_tiles=4)
 
     # Per-row delta = rowsum(dO * O): one fused elementwise reduce, [BH, T, 1].
     delta = jnp.sum(
@@ -297,28 +328,28 @@ def _flash_bwd_vjp(block, interpret, residuals, g):
     )
     lse3 = lse[..., None]  # [BH, T, 1] to satisfy the (8, 128) tiling rule
 
-    q_spec = pl.BlockSpec((1, block, dh), lambda b, i, j: (b, i, 0))
-    k_spec = pl.BlockSpec((1, block, dh), lambda b, i, j: (b, j, 0))
-    row_q = pl.BlockSpec((1, block, 1), lambda b, i, j: (b, i, 0))
+    q_spec = pl.BlockSpec((gh, block, dh), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((gh, block, dh), lambda b, i, j: (b, j, 0))
+    row_q = pl.BlockSpec((gh, block, 1), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block=block, scale=scale),
-        grid=(bh, nblk, nblk),
+        grid=(bh // gh, nblk, nblk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_q, row_q],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, seq, dh), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block, dh), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((gh, block, dh), jnp.float32)],
         interpret=interpret,
     )(q, k, v, g, lse3, delta)
 
     # k-major grid: k/v blocks follow grid dim 1, q-rows follow dim 2.
-    kmaj_k = pl.BlockSpec((1, block, dh), lambda b, i, j: (b, i, 0))
-    kmaj_q = pl.BlockSpec((1, block, dh), lambda b, i, j: (b, j, 0))
-    kmaj_row = pl.BlockSpec((1, block, 1), lambda b, i, j: (b, j, 0))
+    kmaj_k = pl.BlockSpec((gh, block, dh), lambda b, i, j: (b, i, 0))
+    kmaj_q = pl.BlockSpec((gh, block, dh), lambda b, i, j: (b, j, 0))
+    kmaj_row = pl.BlockSpec((gh, block, 1), lambda b, i, j: (b, j, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block=block, scale=scale),
-        grid=(bh, nblk, nblk),
+        grid=(bh // gh, nblk, nblk),
         in_specs=[kmaj_q, kmaj_k, kmaj_k, kmaj_q, kmaj_row, kmaj_row],
         out_specs=[kmaj_k, kmaj_k],
         out_shape=[
@@ -326,8 +357,8 @@ def _flash_bwd_vjp(block, interpret, residuals, g):
             jax.ShapeDtypeStruct((bh, seq, dh), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block, dh), jnp.float32),
-            pltpu.VMEM((block, dh), jnp.float32),
+            pltpu.VMEM((gh, block, dh), jnp.float32),
+            pltpu.VMEM((gh, block, dh), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, g, lse3, delta)
