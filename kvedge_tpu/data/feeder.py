@@ -19,6 +19,14 @@ endian token count, int32 tokens. Batch order is deterministic — batch
 wrapping modulo the corpus — so a training run resumed at step ``k``
 (``start_batch=k``) sees exactly the batches it would have seen without
 the restart: the feeder's half of the checkpoint/resume contract.
+
+Multi-host sharding: a logical batch may span ``global_batch`` rows of
+which this feeder produces ``batch`` rows starting at global row
+``shard_offset`` (host p of P passes ``batch=global//P,
+shard_offset=p*global//P``). ``start_batch`` stays a GLOBAL batch index,
+so every host resumes with the same arithmetic, and concatenating the P
+hosts' outputs row-wise reconstructs the single-host batch exactly —
+pinned by tests/test_feeder.py.
 """
 
 from __future__ import annotations
@@ -72,13 +80,39 @@ def _load_native():
         if _lib is not None:
             return _lib or None
         try:
-            if not _LIB_PATH.exists():
+            # Run `make` even when the .so already exists: the build is
+            # dependency-checked (a no-op when current), and skipping it
+            # would load a STALE library after an in-place source update —
+            # dlopen caches by path, so a missing symbol discovered at
+            # bind time is too late to rebuild. Environments without a
+            # toolchain but with a prebuilt, current .so (the runtime
+            # image) still load it: a failed make only raises when no
+            # library exists at all.
+            try:
                 subprocess.run(
                     ["make", "-C", str(_NATIVE_DIR)],
                     check=True, capture_output=True,
                 )
+            except (OSError, subprocess.SubprocessError):
+                if not _LIB_PATH.exists():
+                    raise
             lib = ctypes.CDLL(str(_LIB_PATH))
-        except (OSError, subprocess.SubprocessError) as e:
+            # Symbol binding stays inside the try: a prebuilt library from
+            # an older source revision lacks newer symbols, and that must
+            # surface as the loud Python fallback (AttributeError), not an
+            # uncaught crash in open_feeder.
+            lib.kvf_open_sharded.restype = ctypes.c_void_p
+            lib.kvf_open_sharded.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_ulonglong, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.kvf_next.restype = ctypes.c_int
+            lib.kvf_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.kvf_tokens.restype = ctypes.c_ulonglong
+            lib.kvf_tokens.argtypes = [ctypes.c_void_p]
+            lib.kvf_close.argtypes = [ctypes.c_void_p]
+            lib.kvf_last_error.restype = ctypes.c_char_p
+        except (OSError, subprocess.SubprocessError, AttributeError) as e:
             # Loud fallback: a silently-degraded input pipeline is the
             # exact stall the native feeder exists to prevent, so say
             # why (a missing toolchain reads very differently from a
@@ -91,19 +125,8 @@ def _load_native():
                 f"fallback ({type(e).__name__}{detail})",
                 RuntimeWarning, stacklevel=3,
             )
-            _lib = False  # cached negative: no toolchain / no lib
+            _lib = False  # cached negative: no toolchain / no / stale lib
             return None
-        lib.kvf_open.restype = ctypes.c_void_p
-        lib.kvf_open.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_ulonglong,
-        ]
-        lib.kvf_next.restype = ctypes.c_int
-        lib.kvf_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
-        lib.kvf_tokens.restype = ctypes.c_ulonglong
-        lib.kvf_tokens.argtypes = [ctypes.c_void_p]
-        lib.kvf_close.argtypes = [ctypes.c_void_p]
-        lib.kvf_last_error.restype = ctypes.c_char_p
         _lib = lib
         return lib
 
@@ -112,7 +135,8 @@ class TokenFeeder:
     """Iterator of [batch, seq+1] int32 batches via the native feeder."""
 
     def __init__(self, path: str | os.PathLike, batch: int, seq: int,
-                 depth: int = 4, start_batch: int = 0):
+                 depth: int = 4, start_batch: int = 0,
+                 global_batch: int = 0, shard_offset: int = 0):
         lib = _load_native()
         if lib is None:
             raise RuntimeError(
@@ -121,8 +145,9 @@ class TokenFeeder:
             )
         self._lib = lib
         self._batch, self._seq = batch, seq
-        self._handle = lib.kvf_open(
-            str(path).encode(), batch, seq, depth, start_batch
+        self._handle = lib.kvf_open_sharded(
+            str(path).encode(), batch, seq, depth, start_batch,
+            global_batch or batch, shard_offset,
         )
         if not self._handle:
             raise ValueError(lib.kvf_last_error().decode())
@@ -168,8 +193,16 @@ class PyTokenFeeder:
     """
 
     def __init__(self, path: str | os.PathLike, batch: int, seq: int,
-                 depth: int = 4, start_batch: int = 0):
+                 depth: int = 4, start_batch: int = 0,
+                 global_batch: int = 0, shard_offset: int = 0):
         del depth  # no prefetching; signature parity with TokenFeeder
+        global_batch = global_batch or batch
+        if not (0 <= shard_offset and shard_offset + batch <= global_batch):
+            # Same open-time rejection as the native feeder.
+            raise ValueError(
+                "shard must satisfy 0 <= shard_offset and "
+                "shard_offset + batch <= global_batch"
+            )
         self.n_tokens = read_corpus_header(path)
         if self.n_tokens < seq + 1:
             raise ValueError("corpus smaller than one sequence")
@@ -183,6 +216,7 @@ class PyTokenFeeder:
                 "corpus header claims more tokens than the file holds"
             )
         self._batch, self._seq = batch, seq
+        self._global_batch, self._shard_offset = global_batch, shard_offset
         self._index = start_batch
 
     def __iter__(self):
@@ -191,7 +225,10 @@ class PyTokenFeeder:
     def __next__(self) -> np.ndarray:
         out = np.empty((self._batch, self._seq + 1), np.int32)
         for r in range(self._batch):
-            start = (self._index * self._batch + r) * self._seq % self.n_tokens
+            start = (
+                (self._index * self._global_batch + self._shard_offset + r)
+                * self._seq % self.n_tokens
+            )
             idx = (start + np.arange(self._seq + 1)) % self.n_tokens
             out[r] = self._tokens[idx]
         self._index += 1
@@ -208,8 +245,9 @@ class PyTokenFeeder:
 
 
 def open_feeder(path: str | os.PathLike, batch: int, seq: int,
-                depth: int = 4, start_batch: int = 0):
+                depth: int = 4, start_batch: int = 0,
+                global_batch: int = 0, shard_offset: int = 0):
     """The native feeder when buildable, the Python fallback otherwise."""
-    if _load_native() is not None:
-        return TokenFeeder(path, batch, seq, depth, start_batch)
-    return PyTokenFeeder(path, batch, seq, depth, start_batch)
+    cls = TokenFeeder if _load_native() is not None else PyTokenFeeder
+    return cls(path, batch, seq, depth, start_batch,
+               global_batch=global_batch, shard_offset=shard_offset)

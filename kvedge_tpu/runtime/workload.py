@@ -157,6 +157,14 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     analogue of EdgeHub's PVC-backed message state (reference
     ``README.md:88``). A run whose target was already reached reports ok
     immediately.
+
+    On a multi-host slice (``jax.process_count() > 1``) each process
+    feeds its own rows of the global batch (sharded feeder offsets) and
+    the global array is assembled with
+    ``jax.make_array_from_process_local_data``; checkpoints then REQUIRE
+    ``[runtime] checkpoint_dir`` on shared storage. A killed slice
+    resumes to the same trajectory as an uninterrupted single-process
+    run (tests/test_distributed.py).
     """
     base = run_device_check(cfg)
     if not base.ok:
@@ -165,6 +173,9 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     import dataclasses
     import functools
     import math
+
+    import jax
+    import numpy as np
 
     from kvedge_tpu.data import open_feeder
     from kvedge_tpu.models import TransformerConfig
@@ -196,6 +207,32 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
                 "batch, sharded across data-parallel devices"
             ),
         )
+    # Multi-host slice: every process feeds its own shard of the global
+    # batch (per-host feeder offsets) and assembles the global array from
+    # process-local data. Checkpoints must live on storage every host can
+    # reach — per-host PVCs cannot hold a slice-wide checkpoint.
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        if not cfg.checkpoint_dir:
+            return dataclasses.replace(
+                base, ok=False,
+                error=(
+                    "multi-host train needs [runtime] checkpoint_dir on "
+                    "shared storage (a shared-filesystem mount or "
+                    "gs://bucket/prefix): per-host PVCs cannot hold a "
+                    "slice-wide checkpoint (README 'Multi-host')"
+                ),
+            )
+        if cfg.train_batch % n_proc:
+            return dataclasses.replace(
+                base, ok=False,
+                error=(
+                    f"[payload] batch = {cfg.train_batch} must divide by "
+                    f"the process count ({n_proc}) for per-host feeding"
+                ),
+            )
+    local_rows = cfg.train_batch // n_proc
+    shard_offset = jax.process_index() * local_rows
     tcfg = TransformerConfig(
         vocab=PROBE_VOCAB,
         d_model=PROBE_D_MODEL,
@@ -213,17 +250,32 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
         ) as ckpt:
             resume_step = ckpt.latest_step() or 0
         feeder = open_feeder(
-            cfg.train_corpus, batch=cfg.train_batch, seq=cfg.train_seq,
-            start_batch=resume_step,
+            cfg.train_corpus, batch=local_rows, seq=cfg.train_seq,
+            start_batch=resume_step, global_batch=cfg.train_batch,
+            shard_offset=shard_offset,
         )
         mesh = build_mesh(cfg.mesh)
         # The payload model is compact (vocab 512); fold arbitrary token
         # ids into range rather than letting the embedding lookup clamp
         # them silently. Deterministic, so resume stays exact. Every
         # batch and the (fresh or restored) state shard onto the mesh.
-        batches = (
-            shard_batch(mesh, batch % tcfg.vocab) for batch in feeder
-        )
+        if n_proc > 1:
+            from jax.sharding import NamedSharding
+
+            from kvedge_tpu.parallel.sharding import batch_spec
+
+            sharding = NamedSharding(mesh, batch_spec(mesh))
+            global_shape = (cfg.train_batch, cfg.train_seq + 1)
+            batches = (
+                jax.make_array_from_process_local_data(
+                    sharding, np.asarray(batch) % tcfg.vocab, global_shape
+                )
+                for batch in feeder
+            )
+        else:
+            batches = (
+                shard_batch(mesh, batch % tcfg.vocab) for batch in feeder
+            )
 
         last_write = 0.0
 

@@ -84,6 +84,47 @@ int main(int argc, char **argv) {
   }
   kvf_close(h);
 
+  // Sharded parity under the sanitizers: two half-batch shards raced
+  // against their own prefetch threads must reproduce the global batch
+  // row-for-row.
+  {
+    void *g = kvf_open(corpus, batch, seq, 2, 0);
+    void *lo = kvf_open_sharded(corpus, batch / 2, seq, 2, 0, batch, 0);
+    void *hi = kvf_open_sharded(corpus, batch / 2, seq, 2, 0, batch,
+                                batch / 2);
+    if (!g || !lo || !hi) {
+      fprintf(stderr, "sharded open failed: %s\n", kvf_last_error());
+      return 1;
+    }
+    std::vector<int32_t> whole(batch * (seq + 1));
+    std::vector<int32_t> half(batch / 2 * (seq + 1));
+    for (int i = 0; i < 8; ++i) {
+      if (kvf_next(g, whole.data()) != 0 || kvf_next(lo, half.data()) != 0) {
+        fprintf(stderr, "sharded next failed\n");
+        return 1;
+      }
+      if (memcmp(whole.data(), half.data(),
+                 half.size() * sizeof(int32_t)) != 0) {
+        fprintf(stderr, "low shard diverged from global batch at %d\n", i);
+        return 1;
+      }
+      if (kvf_next(hi, half.data()) != 0 ||
+          memcmp(whole.data() + half.size(), half.data(),
+                 half.size() * sizeof(int32_t)) != 0) {
+        fprintf(stderr, "high shard diverged from global batch at %d\n", i);
+        return 1;
+      }
+    }
+    // Shard bounds are validated at open.
+    if (kvf_open_sharded(corpus, batch, seq, 2, 0, batch, 1) != nullptr) {
+      fprintf(stderr, "out-of-range shard unexpectedly opened\n");
+      return 1;
+    }
+    kvf_close(g);
+    kvf_close(lo);
+    kvf_close(hi);
+  }
+
   // Close while the producer is blocked on a full ring (depth 1): one
   // consumed batch proves the thread is producing; it then refills the
   // single slot and *blocks* in can_produce.wait — the sleep gives it

@@ -61,8 +61,10 @@ struct Feeder {
   size_t map_bytes = 0;
   void *map_base = nullptr;
 
-  int batch = 0;
+  int batch = 0;       // rows THIS feeder produces per batch
   int seq = 0;
+  int global_batch = 0;  // rows per logical batch across all hosts
+  int shard_offset = 0;  // first global row this feeder covers
   size_t batch_elems = 0;  // batch * (seq + 1)
 
   // Bounded ring buffer of prefetched batches.
@@ -87,11 +89,15 @@ struct Feeder {
   }
 
   void fill_batch(uint64_t index, int32_t *out) const {
-    // Row r of batch `index` starts at token (index*batch + r) * seq,
-    // wrapping modulo the corpus.
+    // Local row r is global row (shard_offset + r) of global batch
+    // `index`; that row starts at token
+    // (index*global_batch + shard_offset + r) * seq, wrapping modulo the
+    // corpus. Single-host (global_batch == batch, shard_offset == 0)
+    // reduces to the original (index*batch + r) * seq.
     for (int r = 0; r < batch; ++r) {
-      uint64_t start =
-          (static_cast<uint64_t>(index) * batch + r) * seq % n_tokens;
+      uint64_t start = (static_cast<uint64_t>(index) * global_batch +
+                        shard_offset + r) *
+                       seq % n_tokens;
       size_t row_len = static_cast<size_t>(seq) + 1;
       uint64_t contiguous = n_tokens - start;
       if (contiguous >= row_len) {
@@ -130,9 +136,22 @@ extern "C" {
 const char *kvf_last_error() { return g_last_error.c_str(); }
 
 void *kvf_open(const char *path, int batch, int seq, int depth,
-               unsigned long long start_batch) try {
+               unsigned long long start_batch) {
+  return kvf_open_sharded(path, batch, seq, depth, start_batch, batch, 0);
+}
+
+void *kvf_open_sharded(const char *path, int batch, int seq, int depth,
+                       unsigned long long start_batch, int global_batch,
+                       int shard_offset) try {
   if (batch <= 0 || seq <= 0 || depth <= 0) {
     g_last_error = "batch, seq, and depth must be positive";
+    return nullptr;
+  }
+  if (global_batch < batch || shard_offset < 0 ||
+      shard_offset + batch > global_batch) {
+    g_last_error =
+        "shard must satisfy 0 <= shard_offset and "
+        "shard_offset + batch <= global_batch";
     return nullptr;
   }
   auto owned = std::make_unique<Feeder>();
@@ -179,6 +198,8 @@ void *kvf_open(const char *path, int batch, int seq, int depth,
   feeder->n_tokens = n_tokens;
   feeder->batch = batch;
   feeder->seq = seq;
+  feeder->global_batch = global_batch;
+  feeder->shard_offset = shard_offset;
   feeder->batch_elems = static_cast<size_t>(batch) * (seq + 1);
   feeder->ring.resize(depth);
   for (auto &slot : feeder->ring) slot.resize(feeder->batch_elems);

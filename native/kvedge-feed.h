@@ -16,6 +16,16 @@ extern "C" {
 void *kvf_open(const char *path, int batch, int seq, int depth,
                unsigned long long start_batch);
 
+// Multi-host form: each logical batch has `global_batch` rows, of which
+// this feeder produces the `batch` rows starting at row `shard_offset`
+// (host p of P passes batch = global/P, shard_offset = p * global/P).
+// `start_batch` stays a GLOBAL batch index, so checkpoint/resume math is
+// identical on every host. kvf_open == kvf_open_sharded with
+// global_batch = batch, shard_offset = 0.
+void *kvf_open_sharded(const char *path, int batch, int seq, int depth,
+                       unsigned long long start_batch, int global_batch,
+                       int shard_offset);
+
 // Blocking copy of the next [batch, seq+1] int32 batch. 0 = ok.
 int kvf_next(void *h, int32_t *out);
 

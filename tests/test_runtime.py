@@ -496,3 +496,23 @@ def test_boot_accepts_matching_topology_env(tmp_path, monkeypatch):
         assert handle.check.ok, handle.check.error
     finally:
         handle.shutdown()
+
+
+def test_train_payload_multihost_requires_shared_checkpoint_dir(
+        tmp_path, monkeypatch):
+    """On a multi-process slice, the train payload must refuse per-host-PVC
+    checkpoints with an actionable message (not silently write N divergent
+    checkpoint sets)."""
+    import jax
+
+    from kvedge_tpu.runtime.workload import run_train_payload
+
+    corpus = _write_train_corpus(tmp_path)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    result = run_train_payload(_cfg(
+        tmp_path, payload="train", train_corpus=corpus, train_steps=2,
+        train_batch=8, train_seq=16,
+    ))
+    assert not result.ok
+    assert "checkpoint_dir" in result.error
+    assert "shared storage" in result.error
