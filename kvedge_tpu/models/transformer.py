@@ -161,12 +161,9 @@ class TransformerConfig:
             if self.attention in ("ring", "ulysses"):
                 raise ValueError(
                     "pipeline parallelism does not compose with "
-                    "sequence-parallel attention yet (their collectives "
-                    "would nest inside the stage-local layer body)"
-                )
-            if self.n_experts:
-                raise ValueError(
-                    "pipeline parallelism does not compose with MoE yet"
+                    "sequence-parallel attention yet (ring/ulysses run "
+                    "their own shard_map, which cannot nest inside the "
+                    "pipeline's)"
                 )
 
 
@@ -284,11 +281,17 @@ def split_qkv(cfg: TransformerConfig, qkv):
     return q, k, v
 
 
-def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
+def _layer(cfg: TransformerConfig, x, layer_params, mesh=None,
+           constrain_moe: bool = True):
     """One pre-norm decoder block. x: [B, T, D] in compute dtype.
 
     Returns ``(x, aux)`` — ``aux`` is the MoE router's load-balancing
-    loss for this layer (0.0 for a dense FFN).
+    loss for this layer (0.0 for a dense FFN). ``constrain_moe=False``
+    drops the MoE activation sharding constraint: inside the pipeline's
+    partial-manual shard_map a NamedSharding over the mesh cannot be
+    expressed (manual axes are rejected), and expert placement instead
+    rides the expert weights' own sharding through the dispatch/combine
+    einsums.
     """
     if cfg.n_experts:
         w_qkv, w_out, router, w_up, w_down, ln_attn, ln_mlp = layer_params
@@ -362,7 +365,7 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None):
         out, aux = moe_ffn(
             normed.reshape(batch * seq, d), router, w_up, w_down,
             capacity_factor=cfg.expert_capacity_factor,
-            top_k=cfg.expert_top_k, mesh=mesh,
+            top_k=cfg.expert_top_k, mesh=mesh if constrain_moe else None,
         )
         x = x + out.reshape(batch, seq, d)
     else:
@@ -413,14 +416,19 @@ def forward_hidden(params: dict, tokens, cfg: TransformerConfig,
             )
         from kvedge_tpu.parallel.pipeline import pipeline_layers
 
-        x = pipeline_layers(
+        # The ``expert`` axis (like ``model``) stays automatic inside the
+        # pipeline's shard_map; constrain_moe=False because an activation
+        # NamedSharding cannot be expressed in that partial-manual
+        # context — expert placement propagates from the stacked expert
+        # weights' own sharding instead.
+        x, aux = pipeline_layers(
             x, stacked,
-            lambda carry, lp: _layer(cfg, carry, lp, None)[0],
+            lambda carry, lp: _layer(cfg, carry, lp, mesh,
+                                     constrain_moe=False),
             mesh, n_layers=cfg.n_layers,
             n_microbatches=cfg.pipeline_microbatches, remat=cfg.remat,
             remat_policy=_remat_policy(cfg),
         )
-        aux = jnp.zeros((), jnp.float32)  # pipeline excludes MoE (validate)
         return _rmsnorm(x, params["ln_final"]), aux
 
     def body(carry, layer_params):

@@ -26,13 +26,18 @@ TPU-first design:
   hand-written stage logic.
 
 Composes with ``data`` parallelism (microbatches shard their batch dim on
-``data``) and with ``model`` tensor parallelism: only stage/data go
-manual in the shard_map, so a ``model`` axis stays *automatic* and XLA
-keeps Megatron-partitioning the stacked params' feature dims (and
-inserting the tp collectives) inside each stage body. Sequence-parallel
-attention and MoE layers are rejected for now — their own manual
-collectives would have to nest inside the stage-local layer body
-(future work, README).
+``data``), with ``model`` tensor parallelism, and with ``expert`` MoE
+parallelism: only stage/data go manual in the shard_map, so ``model``
+and ``expert`` axes stay *automatic* — XLA keeps Megatron-partitioning
+feature dims and partitioning the MoE dispatch/combine einsums (the
+expert all-to-alls) inside each stage body. MoE under pipelining has two
+semantic shifts, both inherent to microbatching: expert capacity binds
+per microbatch (ceil(k*mb_tokens*factor/E) slots per microbatch rather
+than one batch-wide pool), and the router's load-balancing statistics
+are computed per microbatch and averaged — fill/drain steps, which
+compute on garbage, are masked out of that average (see ``step_fn``).
+Sequence-parallel attention is still rejected — ring/ulysses run their
+own shard_map, which cannot nest inside this one.
 """
 
 from __future__ import annotations
@@ -56,9 +61,12 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
     """Run ``n_layers`` stacked layers over ``x``, pipelined over stages.
 
     x: [B, T, D] (compute dtype); ``stacked``: tuple of layer-stacked
-    arrays, each [L, ...]; ``layer_fn(carry, layer_params) -> carry`` is
-    the single-layer body (already closed over the config). Returns
-    [B, T, D].
+    arrays, each [L, ...]; ``layer_fn(carry, layer_params) ->
+    (carry, aux)`` is the single-layer body (already closed over the
+    config), where ``aux`` is its scalar auxiliary loss (the MoE router's
+    load-balancing term; 0.0 for dense layers). Returns ``(out [B, T, D],
+    aux scalar fp32)`` — ``aux`` is the mean over real (non-bubble)
+    microbatch×layer evaluations, replicated across the mesh.
     """
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if stage_axis not in axis_sizes:
@@ -72,18 +80,20 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
             f"n_layers {n_layers} must divide by the {stage_axis!r} axis "
             f"size {stages} (whole layers per stage)"
         )
-    if ("model" in axis_sizes and axis_sizes["model"] > 1
+    if (any(axis_sizes.get(ax, 1) > 1 for ax in ("model", "expert"))
             and x.dtype == jnp.bfloat16
             and jax.default_backend() == "cpu"):
         # XLA's CPU layout-assignment pass crashes the process ("Invalid
         # binary instruction opcode copy") on bf16 contractions against
         # auto-partitioned operands inside shard_map — a backend compiler
         # bug (observed on jax 0.9.0 / CPU only; the TPU backend compiles
-        # this fine). A loud error beats a segfault in test environments.
+        # this fine; hits both the Megatron model axis and the MoE expert
+        # axis). A loud error beats a segfault in test environments.
         raise ValueError(
-            "bf16 pipeline x tensor parallelism trips an XLA CPU-backend "
-            "compiler crash; use float32 compute (dtype='float32') when "
-            "testing this combination on the CPU backend"
+            "bf16 pipeline x auto-partitioned model/expert axes trip an "
+            "XLA CPU-backend compiler crash; use float32 compute "
+            "(dtype='float32') when testing these combinations on the "
+            "CPU backend"
         )
     batch = x.shape[0]
     micro = n_microbatches or stages
@@ -111,11 +121,8 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
             body_fn = layer_fn
             if remat:
                 body_fn = jax.checkpoint(body_fn, policy=remat_policy)
-            h, _ = lax.scan(
-                lambda carry, lp: (body_fn(carry, lp), None),
-                h, stacked_local,
-            )
-            return h
+            h, auxes = lax.scan(body_fn, h, stacked_local)
+            return h, jnp.mean(auxes)
 
         # Initial carries must already vary over the stage axis: the loop
         # body mixes in stage-dependent values (axis_index, ppermute), and
@@ -125,14 +132,24 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
         zero_stage = stage.astype(x_local.dtype) * 0.0
         state0 = x_local[0] * 0.0 + zero_stage
         outputs0 = x_local * 0.0 + zero_stage
+        # The aux accumulator's carry type must already vary over BOTH
+        # manual axes (stage from axis_index, data from the input tokens)
+        # or scan rejects the carry as type-unstable.
+        aux0 = (x_local.ravel()[0].astype(jnp.float32) * 0.0
+                + stage.astype(jnp.float32) * 0.0)
 
         def step_fn(carry, step):
-            state, outputs = carry
+            state, outputs, aux_acc = carry
             # Stage 0 feeds microbatch `step` during the fill phase;
             # later stages consume what the previous stage sent.
             feed = x_local[jnp.clip(step, 0, micro - 1)]
             h = jnp.where(stage == 0, feed, state)
-            h = apply_local_layers(h)
+            h, aux_mb = apply_local_layers(h)
+            # Stage k computes real work at steps [k, k + micro); the
+            # fill/drain bubble steps run on garbage and must not leak
+            # into the router statistics.
+            real = (step >= stage) & (step < stage + micro)
+            aux_acc = aux_acc + jnp.where(real, aux_mb, 0.0)
             # The last stage finishes microbatch `step - (S-1)`.
             out_idx = step - (stages - 1)
             finished = (stage == stages - 1) & (out_idx >= 0)
@@ -142,16 +159,23 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
                 outputs,
             )
             state = lax.ppermute(h, stage_axis, forward_hop)
-            return (state, outputs), None
+            return (state, outputs, aux_acc), None
 
-        (_, outputs), _ = lax.scan(
-            step_fn, (state0, outputs0), jnp.arange(steps)
+        (_, outputs, aux_acc), _ = lax.scan(
+            step_fn, (state0, outputs0, aux0), jnp.arange(steps)
         )
         # Only the last stage holds real outputs; zero elsewhere, so one
         # psum over the stage axis replicates them to every stage (its
         # transpose under grad is a cheap broadcast).
         outputs = jnp.where(stage == stages - 1, outputs, 0.0)
-        return lax.psum(outputs, stage_axis)
+        # Each stage accumulated `micro` real per-microbatch aux means
+        # over its local layers; the full-depth, all-microbatch mean is
+        # the stage-sum divided by micro*stages, then averaged over data
+        # shards (each feeds different tokens).
+        aux = lax.psum(aux_acc, stage_axis) / (micro * stages)
+        if dspec:
+            aux = lax.pmean(aux, data_axis)
+        return lax.psum(outputs, stage_axis), aux
 
     # Only the stage (and data) axes go manual; any other mesh axis —
     # notably a Megatron ``model`` axis on the stacked params' feature
@@ -161,11 +185,11 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
     manual = frozenset(
         {stage_axis} | ({data_axis} if dspec else set())
     )
-    out = jax.shard_map(
+    out, aux = jax.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=_stage_specs(len(stacked), dspec),
-        out_specs=P(None, dspec, None, None),
+        out_specs=(P(None, dspec, None, None), P()),
         axis_names=manual,
     )(x_mb, *stacked)
-    return out.reshape(batch, *x.shape[1:])
+    return out.reshape(batch, *x.shape[1:]), aux

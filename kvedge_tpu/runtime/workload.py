@@ -69,19 +69,20 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
     n_experts = axis_sizes.get("expert", 1)
     # A ``stage`` axis pipelines the probe's layer stack (GPipe schedule
     # with ppermute hand-offs). Probe layers scale to one per stage.
+    # stage x model AND stage x expert compose (both stay automatic
+    # inside the pipeline's shard_map); only sequence-parallel attention
+    # cannot nest (its own shard_map).
     stages = axis_sizes.get("stage", 1)
-    if stages > 1 and (sp > 1 or n_experts > 1):
+    if stages > 1 and sp > 1:
         # A healthy runtime with an un-runnable mesh combination: surface
         # a clear config message, not a generic "probe failed" traceback.
-        # (stage x model IS supported — the model axis stays automatic
-        # inside the pipeline's shard_map.)
         return dataclasses.replace(
             base, ok=False,
             error=(
-                "mesh combines 'stage' with 'seq'/'expert' — pipeline "
-                "parallelism does not compose with sequence/expert "
-                "parallelism yet (README future work); use one scale-out "
-                "family per mesh"
+                "mesh combines 'stage' with 'seq' — pipeline parallelism "
+                "does not compose with sequence-parallel attention "
+                "(ring/ulysses run their own shard_map); use one of the "
+                "two per mesh"
             ),
         )
     try:
@@ -91,12 +92,13 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
         n_layers = PROBE_LAYERS
         if stages > 1 and n_layers % stages:
             n_layers = stages  # one layer per stage
-        # pp x tp probes run fp32: bf16 contractions against the
-        # auto-partitioned model axis crash XLA's CPU backend (see
+        # pp x tp and pp x ep probes run fp32: bf16 contractions against
+        # auto-partitioned model/expert axes crash XLA's CPU backend (see
         # parallel/pipeline.py), and the probe must be portable across
         # the CPU test mesh and real TPUs. The probe verifies machinery,
         # not dtype throughput.
-        dtype = ("float32" if stages > 1 and model_axis > 1
+        dtype = ("float32"
+                 if stages > 1 and (model_axis > 1 or n_experts > 1)
                  else TransformerConfig.dtype)
         tcfg = TransformerConfig(
             vocab=PROBE_VOCAB,

@@ -49,7 +49,10 @@ PP_MESHES = {
 
 
 def mesh_from(axes):
-    return build_mesh(MeshSpec(axes=axes))
+    n = 1
+    for _, size in axes:
+        n *= size
+    return build_mesh(MeshSpec(axes=axes), devices=jax.devices()[:n])
 
 
 @pytest.mark.parametrize("axes", PP_MESHES.values(), ids=PP_MESHES.keys())
@@ -155,10 +158,10 @@ def test_config_validation():
         dataclasses.replace(PP_CFG, n_layers=3).validate()
     with pytest.raises(ValueError, match="sequence-parallel"):
         dataclasses.replace(PP_CFG, attention="ring").validate()
-    with pytest.raises(ValueError, match="MoE"):
-        dataclasses.replace(PP_CFG, n_experts=2).validate()
     with pytest.raises(ValueError, match="microbatches"):
         dataclasses.replace(PP_CFG, pipeline_microbatches=-2).validate()
+    # pp x MoE composes since round 2 — validate() must accept it.
+    dataclasses.replace(PP_CFG, n_experts=2).validate()
 
 
 
@@ -233,4 +236,119 @@ def test_transformer_probe_pipeline_on_stage_mesh(tmp_path):
     result = run_transformer_probe(cfg)
     assert result.ok, result.error
     assert result.mesh_shape == (2, 4)
+    assert math.isfinite(result.probe_checksum)
+
+
+# ---- Pipeline x MoE (VERDICT r1 next-round #4: a converted ✗ cell) -------
+#
+# The expert axis, like model, stays AUTOMATIC inside the pipeline's
+# shard_map: XLA partitions the dispatch/combine einsums (the expert
+# all-to-alls) inside each stage-local body. With ample capacity (no
+# drops) the routed network is the same function as its non-pipelined
+# form, so forward/grad parity holds; the router aux loss is averaged
+# over real microbatch evaluations only (fill/drain masked).
+
+MOE_PP_CFG = dataclasses.replace(
+    PP_CFG, n_layers=2, pipeline_stages=2, n_experts=2,
+    # capacity_factor >= n_experts guarantees zero drops per microbatch,
+    # making routing batch-size-invariant (models/moe.py docstring).
+    expert_capacity_factor=2.0,
+)
+MOE_DENSE_CFG = dataclasses.replace(MOE_PP_CFG, pipeline_stages=0)
+
+MOE_PP_MESHES = {
+    "dp-pp": (("data", 2), ("stage", 2)),
+    "pp-ep": (("data", 1), ("stage", 2), ("expert", 2)),
+    "dp-pp-ep": (("data", 2), ("stage", 2), ("expert", 2)),
+}
+
+
+@pytest.mark.parametrize("axes", MOE_PP_MESHES.values(),
+                         ids=MOE_PP_MESHES.keys())
+def test_pipeline_moe_forward_matches_plain_scan(axes):
+    mesh = mesh_from(axes)
+    params = init_params(jax.random.PRNGKey(0), MOE_PP_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+    got = forward(shard_params(mesh, params), tokens, MOE_PP_CFG, mesh)
+    want = forward(params, tokens, MOE_DENSE_CFG, mesh_from((("data", 2),
+                                                            ("expert", 2))))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-4)
+
+
+def test_pipeline_moe_gradients_match_plain_scan():
+    # moe_aux_weight=0 isolates the CE gradients: the aux statistics are
+    # per-microbatch under pipelining (a documented semantic shift), but
+    # the routed network itself must backpropagate identically.
+    cfg = dataclasses.replace(MOE_PP_CFG, moe_aux_weight=0.0)
+    dense = dataclasses.replace(cfg, pipeline_stages=0)
+    mesh = mesh_from((("data", 1), ("stage", 2)))
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    batch = jax.random.randint(jax.random.PRNGKey(3), (4, 33), 0, 128)
+
+    got = jax.grad(loss_fn)(params, batch, cfg, mesh)
+    want = jax.grad(loss_fn)(params, batch, dense,
+                             mesh_from((("data", 1), ("expert", 2))))
+    for name in want:
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]), atol=2e-4,
+            err_msg=f"grad mismatch in {name}",
+        )
+
+
+def test_pipeline_moe_aux_masks_bubble_steps():
+    """The aux loss must come only from real microbatch evaluations: with
+    uniform-ish routing it sits near 1.0; garbage fill/drain steps leaking
+    in would push it far off."""
+    mesh = mesh_from((("data", 1), ("stage", 2), ("expert", 2)))
+    params = init_params(jax.random.PRNGKey(0), MOE_PP_CFG)
+    from kvedge_tpu.models.transformer import forward_with_aux
+
+    _, aux = forward_with_aux(
+        shard_params(mesh, params),
+        jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128),
+        MOE_PP_CFG, mesh,
+    )
+    aux = float(aux)
+    assert np.isfinite(aux)
+    assert 0.9 < aux < 2.5  # E * sum(f*P) is ~1 for near-uniform routing
+
+
+@pytest.mark.parametrize("axes", MOE_PP_MESHES.values(),
+                         ids=MOE_PP_MESHES.keys())
+def test_pipeline_moe_train_step_runs_and_learns(axes):
+    mesh = mesh_from(axes)
+    params = shard_params(mesh, init_params(jax.random.PRNGKey(0),
+                                            MOE_PP_CFG))
+    init_opt, train_step = make_train_step(MOE_PP_CFG, mesh=mesh)
+    opt_state = init_opt(params)
+    batch = shard_batch(
+        mesh,
+        jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                           MOE_PP_CFG.vocab, dtype=jnp.int32),
+    )
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_probe_pp_ep_mesh(tmp_path):
+    import math
+
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    cfg = dataclasses.replace(
+        RuntimeConfig(),
+        name="pp-ep-probe",
+        state_dir=str(tmp_path / "state"),
+        expected_platform="cpu",
+        status_port=0,
+        status_bind="127.0.0.1",
+        mesh=MeshSpec(axes=(("data", 2), ("stage", 2), ("expert", 2))),
+    )
+    result = run_transformer_probe(cfg)
+    assert result.ok, result.error
     assert math.isfinite(result.probe_checksum)

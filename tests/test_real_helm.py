@@ -24,25 +24,16 @@ from kvedge_tpu.config.values import DEFAULT_VALUES
 from kvedge_tpu.render import render_all
 from kvedge_tpu.render.helmlite import Chart
 
+# The same shapes the helmlite consistency suite renders — imported, not
+# copied, so all three referees can never drift apart on coverage.
+from tests.test_chart_consistency import VALUE_MATRIX
+
 CHART_DIR = pathlib.Path(__file__).parent.parent / "deployment" / "helm"
 
 helm = shutil.which("helm")
 pytestmark = pytest.mark.skipif(
     helm is None, reason="no helm binary on PATH (optional conformance run)"
 )
-
-# Mirrors test_chart_consistency.VALUE_MATRIX so all three referees see
-# the same shapes.
-VALUE_MATRIX = [
-    {},
-    {"nameOverride": "my-edge", "publicSshKey": "ssh-ed25519 AAAA op@host"},
-    {"tpuRuntimeEnableExternalSsh": False, "tpuRuntimeDiskSize": "32Gi"},
-    {"jaxRuntimeConfig": '[runtime]\nname = "edge-x"\n',
-     "tpuAccelerator": "tpu-v6e-slice"},
-    {"nameOverride": ""},
-    {"tpuNumHosts": 4,
-     "jaxRuntimeConfig": "[distributed]\nnum_processes = 4\n"},
-]
 
 
 def helm_template(overrides: dict, release: str = "kvedge") -> dict:
@@ -70,7 +61,13 @@ def helm_template(overrides: dict, release: str = "kvedge") -> dict:
         tmp.write(overrides["jaxRuntimeConfig"])
         tmp.close()
         cmd += ["--set-file", f"jaxRuntimeConfig={tmp.name}"]
-    out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+    finally:
+        if tmp is not None:
+            import os
+
+            os.unlink(tmp.name)
     docs = {}
     for doc in out.stdout.split("\n---\n"):
         doc = doc.strip()
