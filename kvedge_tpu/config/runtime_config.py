@@ -32,7 +32,8 @@ DEFAULT_CONFIG_PATH = "/etc/kvedge/config.toml"
 DEFAULT_STATE_DIR = "/var/lib/kvedge/state"
 
 _VALID_PAYLOADS = (
-    "devicecheck", "transformer-probe", "inference-probe", "train", "none",
+    "devicecheck", "transformer-probe", "inference-probe", "train", "serve",
+    "none",
 )
 # "" = auto (ring iff the mesh declares a seq axis); the rest match
 # TransformerConfig.attention (models/transformer.py).

@@ -61,7 +61,10 @@ class KVCache:
 
 def init_cache(cfg: TransformerConfig, batch: int,
                max_seq: int | None = None) -> KVCache:
+    from kvedge_tpu.models.moe import warn_if_train_serve_divergence
+
     cfg.validate()
+    warn_if_train_serve_divergence(cfg)
     shape = (
         cfg.n_layers, batch, max_seq or cfg.max_seq, cfg.kv_heads, cfg.d_head,
     )

@@ -74,7 +74,10 @@ class PagedKVCache:
 
     def __init__(self, cfg: TransformerConfig, *, slots: int, pages: int,
                  page_size: int = 16, max_pages_per_seq: int | None = None):
+        from kvedge_tpu.models.moe import warn_if_train_serve_divergence
+
         cfg.validate()
+        warn_if_train_serve_divergence(cfg)
         self.cfg = cfg
         self.slots = slots
         self.page_size = page_size

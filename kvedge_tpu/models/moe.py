@@ -44,6 +44,39 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+def warn_if_train_serve_divergence(cfg) -> None:
+    """Warn when cached serving can silently disagree with training.
+
+    The serving paths route droplessly; training drops dispatches past
+    capacity. Per-expert demand is at most ``n_tokens`` (a token's top-k
+    choices are distinct experts) and capacity is
+    ``ceil(top_k * n_tokens * factor / E)``, so
+    ``expert_capacity_factor * expert_top_k >= n_experts`` guarantees
+    zero training drops (the two paths then compute the same function);
+    below that, an operator who trains with drops and serves dropless
+    diverges *silently* — hence a loud warning at the serving boundary
+    (cache construction), where the pairing actually happens. Training
+    alone with a binding capacity is a deliberate, standard trade and
+    stays silent.
+    """
+    import warnings
+
+    if (cfg.n_experts
+            and cfg.expert_capacity_factor * cfg.expert_top_k
+            < cfg.n_experts):
+        warnings.warn(
+            f"MoE serving with expert_capacity_factor="
+            f"{cfg.expert_capacity_factor} * expert_top_k="
+            f"{cfg.expert_top_k} < n_experts={cfg.n_experts}: training "
+            "may have dropped dispatches that dropless serving will "
+            "route, so cached decode can disagree with the "
+            "teacher-forced forward pass. Train with "
+            "expert_capacity_factor >= n_experts / expert_top_k for "
+            "exact train/serve agreement (models/moe.py).",
+            RuntimeWarning, stacklevel=3,
+        )
+
+
 def expert_capacity(n_tokens: int, n_experts: int,
                     capacity_factor: float) -> int:
     """Per-expert slot count: ceil(tokens/E * factor), at least 1."""
@@ -156,9 +189,10 @@ def moe_ffn_dropless(x, router_w, w_up, w_down, *, top_k: int = 1):
     to keep static: each token simply runs through its top-k experts,
     combined with the same gates the training path uses (:func:`_route`),
     so cached decode agrees with the teacher-forced forward pass
-    *provided training capacity never bound* (capacity_factor >=
-    n_experts guarantees zero drops; a dispatch dropped in training
-    forward but served here would diverge).
+    *provided training capacity never bound* (capacity_factor * top_k >=
+    n_experts guarantees zero drops — see
+    :func:`warn_if_train_serve_divergence`; a dispatch dropped in
+    training forward but served here would diverge).
 
     Implementation gathers each token's expert weights ([N, D, F] per
     choice) — ideal for decode (N = batch). Large prefills go through

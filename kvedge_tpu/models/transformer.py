@@ -53,7 +53,8 @@ class TransformerConfig:
     # (models/decode.py, models/kvcache.py) route per-token without
     # capacity limits; cached decode agrees with the teacher-forced
     # forward pass exactly when training capacity never binds
-    # (expert_capacity_factor >= n_experts guarantees that).
+    # (expert_capacity_factor * expert_top_k >= n_experts guarantees
+    # that; the serving boundary warns otherwise — models/moe.py).
     n_experts: int = 0
     # Per-expert slot headroom: capacity = ceil(k*tokens/E * factor);
     # dispatches routed past capacity are dropped (residual carries them).

@@ -13,13 +13,14 @@ import dataclasses
 import os
 import threading
 import time
+from typing import Callable
 
 from kvedge_tpu.config.runtime_config import RuntimeConfig
 from kvedge_tpu.parallel.distributed import DistributedState, maybe_initialize
 from kvedge_tpu.runtime import heartbeat
 from kvedge_tpu.runtime.devicecheck import DeviceCheckResult, run_device_check
 from kvedge_tpu.runtime.profiling import CaptureUnavailable, TraceCapture
-from kvedge_tpu.runtime.status import StatusServer
+from kvedge_tpu.runtime.status import GenerateUnavailable, StatusServer
 
 
 @dataclasses.dataclass
@@ -35,6 +36,9 @@ class RuntimeHandle:
     distributed: DistributedState = dataclasses.field(
         default_factory=lambda: DistributedState(active=False)
     )
+    # Set by the ``serve`` payload once its model is restored; the status
+    # server's POST /generate routes through it.
+    serve_fn: Callable[[dict], dict] | None = None
 
     @property
     def status_port(self) -> int:
@@ -119,7 +123,8 @@ def _booting() -> DeviceCheckResult:
     )
 
 
-def _run_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
+def _run_payload(cfg: RuntimeConfig,
+                 handle: "RuntimeHandle") -> DeviceCheckResult:
     if cfg.payload == "none":
         return DeviceCheckResult(
             ok=True, platform="skipped", device_count=0, device_kinds=(),
@@ -138,6 +143,12 @@ def _run_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             from kvedge_tpu.runtime.workload import run_train_payload
 
             return run_train_payload(cfg)
+        if cfg.payload == "serve":
+            from kvedge_tpu.runtime.workload import run_serve_payload
+
+            check, serve_fn = run_serve_payload(cfg)
+            handle.serve_fn = serve_fn
+            return check
         return run_device_check(cfg)
     except Exception as e:
         return _degraded(f"payload {cfg.payload!r} failed: {e!r}")
@@ -189,12 +200,23 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
             )
         return trace_capture.capture(seconds)
 
+    def generate(doc: dict) -> dict:
+        # The handler thread reads handle.serve_fn at request time: it is
+        # None until the serve payload finishes restoring its model.
+        if handle.serve_fn is None:
+            raise GenerateUnavailable(
+                "no generation backend yet (payload is not 'serve', it "
+                "failed, or the runtime is still booting)"
+            )
+        return handle.serve_fn(doc)
+
     server = StatusServer(
         cfg.status_bind, cfg.status_port,
         snapshot=lambda: handle.snapshot(),
         healthy=lambda: handle.check.ok,
         profiler=profile,
         token=cfg.status_token,
+        generator=generate,
     )
     handle = RuntimeHandle(
         cfg=cfg, check=_booting(), writer=writer, server=server,
@@ -219,7 +241,7 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
                 f"(num_processes={cfg.distributed.num_processes}): {e!r}"
             )
         else:
-            handle.check = _run_payload(cfg)
+            handle.check = _run_payload(cfg, handle)
     boot_complete.set()  # safe to touch the backend from handler threads now
     writer.beat_once()  # refresh: the booting heartbeat is now stale
     return handle

@@ -6,17 +6,18 @@ subsystem at all (SURVEY.md §5). kvedge-tpu adds a machine surface behind
 the same LoadBalancer: ``/healthz`` for external monitors, ``/status`` for
 the full runtime picture (devices, mesh, heartbeat age, boot count),
 ``/metrics`` in Prometheus text format, ``/version`` for kubelet probes,
-and ``POST /profile?seconds=N`` for an on-demand profiler trace capture
-(``kvedge_tpu/runtime/profiling.py``).
+``POST /profile?seconds=N`` for an on-demand profiler trace capture
+(``kvedge_tpu/runtime/profiling.py``), and — when the runtime booted the
+``serve`` payload — ``POST /generate`` for greedy decode against the
+checkpointed model (``kvedge_tpu/runtime/workload.py``).
 
 Auth model: the GET surface is read-only by design and stays open (the
 reference's only public surface, SSH, is key-gated; the pod-world /status
-is the ``kubectl get vmi`` analogue and leaks no secrets). The one
-*mutating* route, ``POST /profile``, triggers device work and writes to
-the state volume, so when the runtime config carries ``[status] token``
-(delivered through the same boot-config Secret as the rest of the TOML)
-the POST requires ``Authorization: Bearer <token>`` and answers 401
-otherwise.
+is the ``kubectl get vmi`` analogue and leaks no secrets). The *mutating*
+routes, ``POST /profile`` and ``POST /generate``, trigger device work, so
+when the runtime config carries ``[status] token`` (delivered through the
+same boot-config Secret as the rest of the TOML) every POST requires
+``Authorization: Bearer <token>`` and answers 401 otherwise.
 """
 
 from __future__ import annotations
@@ -30,6 +31,17 @@ from urllib.parse import parse_qs, urlsplit
 
 from kvedge_tpu.runtime.profiling import CaptureBusy, CaptureUnavailable
 from kvedge_tpu.version import __version__
+
+
+class GenerateUnavailable(RuntimeError):
+    """No generation backend is serving (payload is not ``serve``, or the
+    runtime is still booting)."""
+
+
+# Request-body ceiling for POST /generate: a [batch, prompt] token grid at
+# int size is tiny, so 1 MiB is generous — anything bigger is a mistake or
+# abuse of an internet-reachable port, rejected before json.loads.
+_MAX_GENERATE_BODY = 1 << 20
 
 _METRIC_FIELDS = (
     # (snapshot key, metric suffix, help text)
@@ -100,13 +112,15 @@ class StatusServer:
     def __init__(self, bind: str, port: int, snapshot: Callable[[], dict],
                  healthy: Callable[[], bool] | None = None,
                  profiler: Callable[[float], dict] | None = None,
-                 token: str = ""):
+                 token: str = "",
+                 generator: Callable[[dict], dict] | None = None):
         outer = self
         self._healthy = healthy or (
             lambda: bool(snapshot().get("ok", False))
         )
         self._profiler = profiler
         self._token = token
+        self._generator = generator
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet by default
@@ -172,16 +186,19 @@ class StatusServer:
 
             def do_POST(self):
                 url = urlsplit(self.path)
-                if url.path != "/profile":
+                if url.path not in ("/profile", "/generate"):
                     self._send(404, {"error": f"no route {url.path}"})
                     return
                 if not self._authorized():
                     self._send(
                         401,
-                        {"error": "POST /profile requires Authorization: "
-                                  "Bearer <status token>"},
+                        {"error": f"POST {url.path} requires "
+                                  "Authorization: Bearer <status token>"},
                         extra_headers={"WWW-Authenticate": "Bearer"},
                     )
+                    return
+                if url.path == "/generate":
+                    self._handle_generate()
                     return
                 if outer._profiler is None:
                     self._send(503, {"error": "profiler not available"})
@@ -201,6 +218,37 @@ class StatusServer:
                     self._send(503, {"error": str(e)})
                 except Exception as e:  # capture failed; stay serving
                     self._send(500, {"error": f"capture failed: {e!r}"})
+
+            def _handle_generate(self):
+                if outer._generator is None:
+                    self._send(503, {
+                        "error": "no generation backend (boot the 'serve' "
+                                 "payload)"
+                    })
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    length = 0
+                if not 0 < length <= _MAX_GENERATE_BODY:
+                    self._send(400, {
+                        "error": "POST /generate needs a JSON body "
+                                 f"(1..{_MAX_GENERATE_BODY} bytes)"
+                    })
+                    return
+                try:
+                    doc = json.loads(self.rfile.read(length))
+                except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                    self._send(400, {"error": f"invalid JSON body: {e}"})
+                    return
+                try:
+                    self._send(200, outer._generator(doc))
+                except ValueError as e:  # malformed request semantics
+                    self._send(400, {"error": str(e)})
+                except GenerateUnavailable as e:
+                    self._send(503, {"error": str(e)})
+                except Exception as e:  # generation failed; stay serving
+                    self._send(500, {"error": f"generate failed: {e!r}"})
 
         self._snapshot = snapshot
         self._server = ThreadingHTTPServer((bind, port), Handler)

@@ -235,14 +235,7 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             )
     local_rows = cfg.train_batch // n_proc
     shard_offset = jax.process_index() * local_rows
-    tcfg = TransformerConfig(
-        vocab=PROBE_VOCAB,
-        d_model=PROBE_D_MODEL,
-        n_heads=max(4, axis_sizes.get("model", 1)),
-        n_layers=PROBE_LAYERS,
-        d_ff=4 * PROBE_D_MODEL,
-        max_seq=cfg.train_seq,
-    )
+    tcfg, mesh = train_model_config(cfg)
     feeder = None
     try:
         # Peek the resume point first: the feeder must start at the
@@ -256,7 +249,6 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             start_batch=resume_step, global_batch=cfg.train_batch,
             shard_offset=shard_offset,
         )
-        mesh = build_mesh(cfg.mesh)
         # The payload model is compact (vocab 512); fold arbitrary token
         # ids into range rather than letting the embedding lookup clamp
         # them silently. Deterministic, so resume stays exact. Every
@@ -328,6 +320,157 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
         base, probe_ms=elapsed_ms,
         probe_checksum=final_loss if result.losses else 0.0,
     )
+
+
+def train_model_config(cfg: RuntimeConfig):
+    """The train payload's model, derived from the runtime config.
+
+    One definition shared by ``train`` and ``serve`` so the serving
+    payload restores exactly the architecture training checkpointed —
+    a drift here would surface as an orbax tree-structure mismatch.
+    """
+    from kvedge_tpu.models import TransformerConfig
+    from kvedge_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(cfg.mesh)
+    axis_sizes = dict(mesh.shape)
+    return TransformerConfig(
+        vocab=PROBE_VOCAB,
+        d_model=PROBE_D_MODEL,
+        n_heads=max(4, axis_sizes.get("model", 1)),
+        n_layers=PROBE_LAYERS,
+        d_ff=4 * PROBE_D_MODEL,
+        max_seq=cfg.train_seq,
+    ), mesh
+
+
+def run_serve_payload(cfg: RuntimeConfig):
+    """The ``serve`` payload: greedy decode behind ``POST /generate``.
+
+    Closes the loop the state volume exists for: the ``train`` payload
+    checkpoints through it, and a later ``serve`` pod restores the
+    latest checkpoint (params only — optimizer state is training's
+    business) and serves generation requests from it. A fresh volume
+    serves the same deterministic init training would start from, so the
+    endpoint works before any training has happened.
+
+    Returns ``(DeviceCheckResult, serve_fn | None)``; ``serve_fn(doc)``
+    implements the request contract::
+
+        {"tokens": [[int, ...], ...], "n_new": int}   ->
+        {"tokens": [[prompt + generated], ...], "n_new": N,
+         "restored_step": int | null}
+
+    The whole decode loop is one jitted program per (batch, prompt_len,
+    n_new) shape (models/decode.py); a lock serializes requests — this
+    is the reference-scale single-runtime story, not a batching server.
+    """
+    base = run_device_check(cfg)
+    if not base.ok:
+        return base, None
+
+    import dataclasses
+    import threading
+    import time as time_mod
+
+    import jax
+    import jax.numpy as jnp
+
+    from kvedge_tpu.models import generate, init_params
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+
+    try:
+        tcfg, _ = train_model_config(cfg)
+        # Mirror the training driver's state tree exactly (params AND
+        # optimizer state, seed 0 — models/training.py fresh_state): the
+        # checkpoint was written with that structure, and restore needs
+        # the same abstract tree to reassemble it.
+        from kvedge_tpu.models import make_train_step
+
+        init_opt, _ = make_train_step(tcfg)
+
+        def fresh_state():
+            p = init_params(jax.random.PRNGKey(0), tcfg)
+            return {"params": p, "opt_state": init_opt(p)}
+
+        restored_step = None
+        with StateCheckpointer(
+            cfg.state_dir, checkpoint_dir=cfg.checkpoint_dir
+        ) as ckpt:
+            restored = ckpt.restore_latest(jax.eval_shape(fresh_state))
+        if restored is not None:
+            restored_step, tree = restored
+            params = tree["params"]
+        else:
+            # fresh_state stays abstract (eval_shape) — materializing it
+            # here would allocate AdamW moment trees only to discard them.
+            params = init_params(jax.random.PRNGKey(0), tcfg)
+
+        lock = threading.Lock()
+
+        def serve_fn(doc: dict) -> dict:
+            tokens = doc.get("tokens")
+            if (not isinstance(tokens, list) or not tokens
+                    or not all(isinstance(r, list) and r for r in tokens)):
+                raise ValueError(
+                    "body must carry 'tokens': a non-empty list of "
+                    "non-empty token-id rows"
+                )
+            if len({len(r) for r in tokens}) != 1:
+                raise ValueError("all token rows must have equal length")
+            try:
+                n_new = int(doc.get("n_new", 16))
+            except (TypeError, ValueError):
+                raise ValueError("'n_new' must be an integer") from None
+            if not 1 <= n_new <= tcfg.max_seq:
+                raise ValueError(
+                    f"'n_new' must be in [1, {tcfg.max_seq}]"
+                )
+            if len(tokens[0]) + n_new > tcfg.max_seq:
+                raise ValueError(
+                    f"prompt ({len(tokens[0])}) + n_new ({n_new}) exceeds "
+                    f"the model's max_seq ({tcfg.max_seq})"
+                )
+            if not all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for row in tokens for t in row
+            ):
+                # Explicit check: jnp.asarray would silently TRUNCATE
+                # floats (1.9 -> 1) and decode a different prompt than
+                # the client sent.
+                raise ValueError("token rows must contain integers")
+            prompt = jnp.asarray(tokens, jnp.int32) % tcfg.vocab
+            with lock:
+                out = generate(params, prompt, tcfg, n_new=n_new)
+            return {
+                "tokens": [[int(t) for t in row] for row in out.tolist()],
+                "n_new": n_new,
+                "restored_step": restored_step,
+            }
+
+        # Self-check: one tiny generation proves the restored params and
+        # the decode path actually work before the endpoint goes live.
+        # Sized from the model so a small (legal) train_seq cannot fail a
+        # servable payload; max_seq == 1 genuinely cannot serve (every
+        # request needs prompt + n_new >= 2) and errors out here.
+        if tcfg.max_seq < 2:
+            raise ValueError(
+                f"[payload] seq = {tcfg.max_seq} is too small to serve: "
+                "every request needs prompt + n_new >= 2"
+            )
+        probe_prompt = list(range(1, min(4, tcfg.max_seq - 1) + 1))
+        probe_new = min(2, tcfg.max_seq - len(probe_prompt))
+        start = time_mod.perf_counter()
+        probe = serve_fn({"tokens": [probe_prompt], "n_new": probe_new})
+        elapsed_ms = (time_mod.perf_counter() - start) * 1000.0
+    except Exception as e:
+        return dataclasses.replace(
+            base, ok=False, error=f"serve payload failed: {e!r}",
+        ), None
+    return dataclasses.replace(
+        base, probe_ms=elapsed_ms,
+        probe_checksum=float(sum(probe["tokens"][0])),
+    ), serve_fn
 
 
 # Inference probe: small GQA model, short prompt, a few greedy steps.

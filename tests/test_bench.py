@@ -1,0 +1,43 @@
+"""bench.py's reporting math (pure functions; the timed paths run on TPU).
+
+The MFU figure in BENCH_r{N}.json is only as honest as the FLOPs model
+behind it — these tests pin that model against hand-derived counts so a
+refactor cannot silently inflate the headline.
+"""
+
+import dataclasses
+
+from bench import kv_cache_bytes_per_token, model_flops_per_token
+from __graft_entry__ import FLAGSHIP
+
+
+def test_flagship_flops_per_token_hand_count():
+    # FLAGSHIP: D=512, H=8 (MHA), dh=64, F=2048, L=8, V=32000, seq 512.
+    seq = 512
+    qkv = 2 * 512 * (8 + 16) * 64          # fused q|k|v projection
+    attn = 2 * seq * 512 + 2 * seq * 512   # qk^T + weights@v per token
+    out = 2 * 512 * 512
+    ffn = 2 * 512 * 2048 * 2
+    per_layer = qkv + attn + out + ffn
+    fwd = 8 * per_layer + 2 * 512 * 32000  # + tied readout
+    assert model_flops_per_token(FLAGSHIP, seq) == 3.0 * fwd  # fwd + 2x bwd
+
+
+def test_flops_scale_with_sequence():
+    # Only the attention term depends on seq; doubling seq adds exactly
+    # the extra attention FLOPs.
+    f1 = model_flops_per_token(FLAGSHIP, 512)
+    f2 = model_flops_per_token(FLAGSHIP, 1024)
+    extra_attn = 3.0 * FLAGSHIP.n_layers * (
+        2 * 512 * FLAGSHIP.n_heads * FLAGSHIP.d_head * 2
+    )
+    assert f2 - f1 == extra_attn
+
+
+def test_gqa_shrinks_kv_cache_not_flops_much():
+    gqa = dataclasses.replace(FLAGSHIP, n_kv_heads=2)
+    mha = dataclasses.replace(FLAGSHIP, n_kv_heads=0)
+    # The cache bill shrinks by n_heads / n_kv_heads exactly.
+    assert kv_cache_bytes_per_token(mha) == 4 * kv_cache_bytes_per_token(gqa)
+    # L * 2 (K and V) * kv_heads * dh * 2 bytes (bf16)
+    assert kv_cache_bytes_per_token(gqa) == 8 * 2 * 2 * 64 * 2

@@ -365,3 +365,35 @@ def test_validate_rejects_bad_moe_config():
         dataclasses.replace(MOE_CFG, n_experts=-1).validate()
     with pytest.raises(ValueError, match="capacity"):
         dataclasses.replace(MOE_CFG, expert_capacity_factor=0.0).validate()
+
+
+def test_serving_warns_when_training_capacity_can_bind():
+    """VERDICT r1 weak #8: train-with-drops + serve-dropless diverges
+    silently; the serving boundary (cache construction) must warn."""
+    import warnings
+
+    import pytest
+
+    from kvedge_tpu.models import PagedKVCache, init_cache
+
+    risky = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2, d_ff=64,
+        max_seq=16, n_experts=4, expert_capacity_factor=1.25,
+    )
+    safe = dataclasses.replace(risky, expert_capacity_factor=4.0)
+
+    with pytest.warns(RuntimeWarning, match="dropless serving"):
+        init_cache(risky, batch=2)
+    with pytest.warns(RuntimeWarning, match="dropless serving"):
+        PagedKVCache(risky, slots=2, pages=8)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        init_cache(safe, batch=2)          # no warning
+        PagedKVCache(safe, slots=2, pages=8)
+        # top_k scales capacity: factor 2.0 x top_k 2 covers 4 experts,
+        # so this config is provably dropless and must stay silent.
+        top2 = dataclasses.replace(
+            risky, expert_top_k=2, expert_capacity_factor=2.0
+        )
+        init_cache(top2, batch=2)

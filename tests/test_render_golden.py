@@ -7,6 +7,11 @@ Regenerate after an intentional template change with:
     python -m kvedge_tpu render --set tpuNumHosts=4 \
         --set $'jaxRuntimeConfig=[distributed]\nnum_processes = 4\n' \
         --golden tests/golden/multihost
+    python -m kvedge_tpu render --set tpuRuntimeEnableExternalSsh=false \
+        --golden tests/golden/ssh-disabled
+    python -m kvedge_tpu render \
+        --set $'jaxRuntimeConfig=[status]\nport = 9999\n' \
+        --golden tests/golden/custom-port
 
 (the $'...' quoting makes the shell expand the \n escapes — a plain
 '...' would pass literal backslash-n, which is invalid TOML).
@@ -27,6 +32,17 @@ CASES = {
     "multihost": DEFAULT_VALUES.replace(
         tpuNumHosts=4,
         jaxRuntimeConfig="[distributed]\nnum_processes = 4\n",
+    ),
+    # SSH disabled: the conditional LoadBalancer must disappear entirely
+    # (the reference's `if eq .Values.aziotEdgeVmEnableExternalSsh true`
+    # gate, aziot-edge-vm-service.yaml:1).
+    "ssh-disabled": DEFAULT_VALUES.replace(
+        tpuRuntimeEnableExternalSsh=False,
+    ),
+    # Custom status port: the TOML's [status] port must propagate into
+    # the Service, the probe ports, and NOTES.
+    "custom-port": DEFAULT_VALUES.replace(
+        jaxRuntimeConfig="[status]\nport = 9999\n",
     ),
 }
 

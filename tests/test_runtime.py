@@ -450,7 +450,7 @@ def test_status_server_answers_during_boot_work(tmp_path, monkeypatch):
 
     port = 8791  # fixed: the payload must know it before the handle exists
 
-    def probing_payload(cfg):
+    def probing_payload(cfg, handle):
         code, _ = _get(port, "/version")
         try:  # /healthz must be 503 while still booting
             _get(port, "/healthz")

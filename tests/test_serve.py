@@ -1,0 +1,210 @@
+"""The ``serve`` payload: POST /generate against the checkpointed model.
+
+Closes the state-volume loop the runtime exists for: ``train`` writes
+checkpoints through the volume, a later ``serve`` pod restores the latest
+one and serves greedy decode over HTTP. Correctness anchor: the endpoint's
+output must equal the teacher-forced argmax of the restored parameters —
+the same cross-check discipline as the inference probe.
+"""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kvedge_tpu.config.runtime_config import RuntimeConfig
+from kvedge_tpu.runtime.boot import start_runtime
+from kvedge_tpu.runtime.workload import (
+    run_serve_payload,
+    run_train_payload,
+    train_model_config,
+)
+
+
+def _cfg(tmp_path, **overrides):
+    base = dict(
+        name="serve-test",
+        state_dir=str(tmp_path / "state"),
+        expected_platform="cpu",
+        status_port=0,
+        status_bind="127.0.0.1",
+        payload="serve",
+        train_seq=16,
+    )
+    base.update(overrides)
+    return dataclasses.replace(RuntimeConfig(), **base)
+
+
+def _post(url, doc, token=None):
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), headers=headers, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_serve_payload_fresh_volume(tmp_path):
+    check, serve_fn = run_serve_payload(_cfg(tmp_path))
+    assert check.ok, check.error
+    out = serve_fn({"tokens": [[1, 2, 3]], "n_new": 3})
+    assert out["restored_step"] is None  # nothing trained yet
+    assert len(out["tokens"][0]) == 6
+    assert all(isinstance(t, int) for t in out["tokens"][0])
+
+
+def test_serve_matches_teacher_forcing(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from kvedge_tpu.models import forward, init_params
+
+    cfg = _cfg(tmp_path)
+    _, serve_fn = run_serve_payload(cfg)
+    tcfg, _ = train_model_config(cfg)
+    params = init_params(jax.random.PRNGKey(0), tcfg)  # the served init
+
+    prompt = [[5, 9, 2, 7], [1, 1, 4, 3]]
+    out = serve_fn({"tokens": prompt, "n_new": 4})["tokens"]
+    so_far = jnp.asarray(prompt, jnp.int32)
+    for _ in range(4):
+        nxt = jnp.argmax(forward(params, so_far, tcfg)[:, -1], axis=-1)
+        so_far = jnp.concatenate(
+            [so_far, nxt[:, None].astype(jnp.int32)], axis=1
+        )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(so_far))
+
+
+def test_serve_request_validation(tmp_path):
+    _, serve_fn = run_serve_payload(_cfg(tmp_path))
+    for bad in (
+        {},                                      # no tokens
+        {"tokens": []},                          # empty
+        {"tokens": [[1], []]},                   # empty row
+        {"tokens": [[1, 2], [3]]},               # ragged
+        {"tokens": [[1, 2]], "n_new": 0},        # n_new < 1
+        {"tokens": [[1, 2]], "n_new": 10_000},   # n_new > max_seq
+        {"tokens": [[1] * 15], "n_new": 4},      # prompt + n_new > max_seq
+        {"tokens": [["a", "b"]]},                # non-integers
+        {"tokens": [[1.9, 2.2]]},                # floats must NOT truncate
+        {"tokens": [[True, False]]},             # bools are not token ids
+    ):
+        with pytest.raises(ValueError):
+            serve_fn(bad)
+
+
+def test_serve_small_train_seq_still_boots(tmp_path):
+    # A legal train_seq smaller than the default probe shapes must not
+    # fail the payload; the self-check sizes itself from the model.
+    check, serve_fn = run_serve_payload(_cfg(tmp_path, train_seq=4))
+    assert check.ok, check.error
+    out = serve_fn({"tokens": [[1, 2]], "n_new": 2})
+    assert len(out["tokens"][0]) == 4
+
+
+def test_train_then_serve_restores_trained_params(tmp_path):
+    """The whole story: train a few steps, then serve from the SAME state
+    volume — the endpoint must decode with the TRAINED weights, not the
+    init (proven by matching teacher forcing on the restored tree)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kvedge_tpu.data import write_corpus
+    from kvedge_tpu.models import forward
+
+    corpus = tmp_path / "corpus.kvfeed"
+    rng = np.random.default_rng(11)
+    write_corpus(corpus, rng.integers(0, 512, size=3000, dtype=np.int32))
+
+    train_cfg = _cfg(
+        tmp_path, payload="train", train_corpus=str(corpus),
+        train_steps=4, train_batch=8, train_checkpoint_every=2,
+    )
+    result = run_train_payload(train_cfg)
+    assert result.ok, result.error
+
+    serve_cfg = _cfg(tmp_path)
+    check, serve_fn = run_serve_payload(serve_cfg)
+    assert check.ok, check.error
+    out = serve_fn({"tokens": [[3, 1, 4]], "n_new": 2})
+    assert out["restored_step"] == 4
+
+    # Teacher-forced argmax with the restored (trained) params.
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+
+    tcfg, _ = train_model_config(serve_cfg)
+    with StateCheckpointer(serve_cfg.state_dir) as ckpt:
+        _, tree = ckpt.restore_latest()
+    params = tree["params"]
+    so_far = jnp.asarray([[3, 1, 4]], jnp.int32)
+    for _ in range(2):
+        nxt = jnp.argmax(forward(params, so_far, tcfg)[:, -1], axis=-1)
+        so_far = jnp.concatenate(
+            [so_far, nxt[:, None].astype(jnp.int32)], axis=1
+        )
+    np.testing.assert_array_equal(np.asarray(out["tokens"]),
+                                  np.asarray(so_far))
+
+
+# ---- HTTP surface --------------------------------------------------------
+
+
+@pytest.fixture
+def served(tmp_path):
+    handle = start_runtime(_cfg(tmp_path, status_token="serve-tok"))
+    assert handle.check.ok, handle.check.error
+    yield f"http://127.0.0.1:{handle.status_port}"
+    handle.shutdown()
+
+
+def test_http_generate_round_trip(served):
+    code, doc = _post(f"{served}/generate",
+                      {"tokens": [[1, 2, 3]], "n_new": 2},
+                      token="serve-tok")
+    assert code == 200
+    assert len(doc["tokens"][0]) == 5
+
+
+def test_http_generate_requires_token(served):
+    code, doc = _post(f"{served}/generate", {"tokens": [[1]]})
+    assert code == 401
+    code, _ = _post(f"{served}/generate", {"tokens": [[1]]}, token="wrong")
+    assert code == 401
+
+
+def test_http_generate_bad_requests(served):
+    code, doc = _post(f"{served}/generate", {"tokens": []},
+                      token="serve-tok")
+    assert code == 400
+    # Non-JSON body
+    req = urllib.request.Request(
+        f"{served}/generate", data=b"not json",
+        headers={"Authorization": "Bearer serve-tok"}, method="POST",
+    )
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        code = 200
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 400
+
+
+def test_http_generate_503_without_serve_payload(tmp_path):
+    handle = start_runtime(_cfg(tmp_path, payload="devicecheck"))
+    try:
+        code, doc = _post(
+            f"http://127.0.0.1:{handle.status_port}/generate",
+            {"tokens": [[1]]},
+        )
+        assert code == 503
+        assert "serve" in doc["error"]
+    finally:
+        handle.shutdown()
