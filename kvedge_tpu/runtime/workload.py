@@ -29,6 +29,84 @@ PROBE_SEQ = 64
 PROBE_BATCH_PER_DEVICE = 2
 
 
+class MeshConfigError(ValueError):
+    """The operator's mesh cannot run this payload (clear config message)."""
+
+
+def derive_model_config(cfg: RuntimeConfig, *, seq: int):
+    """(TransformerConfig, mesh) for a payload, derived from the mesh.
+
+    One derivation shared by the transformer-probe, ``train``, and
+    ``serve`` payloads, so every mesh family the probe exercises is a
+    mesh family training (and checkpoint-compatible serving) supports:
+
+    * ``seq`` axis -> sequence-parallel attention (ring by default, or
+      the strategy named by ``[payload] attention``; ulysses rounds the
+      head count up to a multiple of the axis);
+    * ``expert`` axis -> mixture-of-experts FFN sharded over it;
+    * ``stage`` axis -> pipelined layer stack (one layer per stage when
+      the default depth doesn't divide); composes with ``model`` and
+      ``expert`` but not ``seq`` (nested shard_maps);
+    * ``model`` axis -> Megatron tensor parallelism (annotation-only).
+
+    Raises :class:`MeshConfigError` for un-runnable combinations.
+    """
+    from kvedge_tpu.models import TransformerConfig
+    from kvedge_tpu.parallel import build_mesh
+
+    mesh = build_mesh(cfg.mesh)
+    axis_sizes = dict(mesh.shape)
+    model_axis = axis_sizes.get("model", 1)
+    sp = axis_sizes.get("seq", 1)
+    attention = cfg.payload_attention or ("ring" if sp > 1 else "naive")
+    n_heads = max(4, model_axis)
+    if attention == "ulysses" and n_heads % sp:
+        # Ulysses scatters heads over the seq axis: round up to the next
+        # multiple of sp.
+        n_heads = sp * -(-n_heads // sp)
+    n_experts = axis_sizes.get("expert", 1)
+    stages = axis_sizes.get("stage", 1)
+    if stages > 1 and sp > 1:
+        raise MeshConfigError(
+            "mesh combines 'stage' with 'seq' — pipeline parallelism "
+            "does not compose with sequence-parallel attention "
+            "(ring/ulysses run their own shard_map); use one of the "
+            "two per mesh"
+        )
+    n_layers = PROBE_LAYERS
+    if stages > 1 and n_layers % stages:
+        n_layers = stages  # one layer per stage
+    # pp x tp and pp x ep run fp32: bf16 contractions against
+    # auto-partitioned model/expert axes crash XLA's CPU backend (see
+    # parallel/pipeline.py), and payloads must be portable across the
+    # CPU test mesh and real TPUs.
+    import jax
+
+    dtype = ("float32"
+             if stages > 1 and (model_axis > 1 or n_experts > 1)
+             and jax.default_backend() == "cpu"
+             else TransformerConfig.dtype)
+    return TransformerConfig(
+        vocab=PROBE_VOCAB,
+        d_model=PROBE_D_MODEL,
+        n_heads=n_heads,
+        n_layers=n_layers,
+        d_ff=4 * PROBE_D_MODEL,
+        max_seq=seq,
+        dtype=dtype,
+        attention=attention,
+        n_experts=n_experts if n_experts > 1 else 0,
+        # Provably drop-free capacity (factor * top_k >= E): the same
+        # derived config feeds train AND serve, and serving routes
+        # droplessly — a binding training capacity would make POST
+        # /generate silently disagree with the trained model (the
+        # warn_if_train_serve_divergence regime, with no TOML knob to
+        # escape it). At payload scale the extra capacity is noise.
+        expert_capacity_factor=float(max(n_experts, 1)),
+        pipeline_stages=stages if stages > 1 else 0,
+    ), mesh
+
+
 def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
     # The matmul device check runs first: fail fast on visibility problems
     # with a cheaper, clearer error before compiling a model.
@@ -42,76 +120,19 @@ def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:
     import jax
     import jax.numpy as jnp
 
-    from kvedge_tpu.models import (
-        TransformerConfig, init_params, make_train_step,
-    )
-    from kvedge_tpu.parallel import build_mesh, shard_batch, shard_params
+    from kvedge_tpu.models import init_params, make_train_step
+    from kvedge_tpu.parallel import shard_batch, shard_params
 
-    mesh = build_mesh(cfg.mesh)
-    axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
-    model_axis = axis_sizes.get("model", 1)
-    sp = axis_sizes.get("seq", 1)
-    # A `seq` axis in the operator's mesh selects the long-context path —
-    # ring attention's ppermute ring by default, or the strategy named by
-    # [payload] attention ("ulysses" = all-to-all head scatter). Either
-    # way the probe exercises real sequence-parallel collectives, not
-    # just the annotation-sharded dp×tp step.
-    attention = cfg.payload_attention or ("ring" if sp > 1 else "naive")
-    sequence_parallel = attention in ("ring", "ulysses")
-    n_heads = max(4, model_axis)
-    if attention == "ulysses" and n_heads % sp:
-        # Ulysses scatters heads over the seq axis: round up to the next
-        # multiple of sp.
-        n_heads = sp * -(-n_heads // sp)
-    # An ``expert`` axis in the operator's mesh turns the probe's FFN
-    # into a mixture of experts sharded over it — the probe then
-    # exercises expert-parallel dispatch/combine too.
-    n_experts = axis_sizes.get("expert", 1)
-    # A ``stage`` axis pipelines the probe's layer stack (GPipe schedule
-    # with ppermute hand-offs). Probe layers scale to one per stage.
-    # stage x model AND stage x expert compose (both stay automatic
-    # inside the pipeline's shard_map); only sequence-parallel attention
-    # cannot nest (its own shard_map).
-    stages = axis_sizes.get("stage", 1)
-    if stages > 1 and sp > 1:
+    try:
+        tcfg, mesh = derive_model_config(cfg, seq=PROBE_SEQ)
+    except MeshConfigError as e:
         # A healthy runtime with an un-runnable mesh combination: surface
         # a clear config message, not a generic "probe failed" traceback.
-        return dataclasses.replace(
-            base, ok=False,
-            error=(
-                "mesh combines 'stage' with 'seq' — pipeline parallelism "
-                "does not compose with sequence-parallel attention "
-                "(ring/ulysses run their own shard_map); use one of the "
-                "two per mesh"
-            ),
-        )
+        return dataclasses.replace(base, ok=False, error=str(e))
     try:
         # Inside the try: an sp-derived head count can make the model
         # config itself invalid (d_model % n_heads), and that must surface
         # as a structured probe failure like every other error here.
-        n_layers = PROBE_LAYERS
-        if stages > 1 and n_layers % stages:
-            n_layers = stages  # one layer per stage
-        # pp x tp and pp x ep probes run fp32: bf16 contractions against
-        # auto-partitioned model/expert axes crash XLA's CPU backend (see
-        # parallel/pipeline.py), and the probe must be portable across
-        # the CPU test mesh and real TPUs. The probe verifies machinery,
-        # not dtype throughput.
-        dtype = ("float32"
-                 if stages > 1 and (model_axis > 1 or n_experts > 1)
-                 else TransformerConfig.dtype)
-        tcfg = TransformerConfig(
-            vocab=PROBE_VOCAB,
-            d_model=PROBE_D_MODEL,
-            n_heads=n_heads,
-            n_layers=n_layers,
-            d_ff=4 * PROBE_D_MODEL,
-            max_seq=PROBE_SEQ,
-            dtype=dtype,
-            attention=attention,
-            n_experts=n_experts if n_experts > 1 else 0,
-            pipeline_stages=stages if stages > 1 else 0,
-        )
         key = jax.random.PRNGKey(0)
         params = shard_params(mesh, init_params(key, tcfg))
         init_opt, train_step = make_train_step(
@@ -187,18 +208,6 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     from kvedge_tpu.runtime.checkpoint import StateCheckpointer
 
     axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
-    unsupported = {"seq", "expert", "stage"} & {
-        axis for axis, size in axis_sizes.items() if size > 1
-    }
-    if unsupported:
-        return dataclasses.replace(
-            base, ok=False,
-            error=(
-                f"train payload supports data x model meshes only; axes "
-                f"{sorted(unsupported)} would be silently ignored — use "
-                "the transformer-probe payload to exercise them"
-            ),
-        )
     data_size = axis_sizes.get("data", 1)
     if cfg.train_batch % max(1, data_size):
         return dataclasses.replace(
@@ -235,7 +244,13 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             )
     local_rows = cfg.train_batch // n_proc
     shard_offset = jax.process_index() * local_rows
-    tcfg, mesh = train_model_config(cfg)
+    # The model derives from the mesh exactly like the probe's (seq axis
+    # -> sequence-parallel attention, expert -> MoE, stage -> pipelined
+    # layers): every mesh family the probe exercises, training trains.
+    try:
+        tcfg, mesh = train_model_config(cfg)
+    except MeshConfigError as e:
+        return dataclasses.replace(base, ok=False, error=str(e))
     feeder = None
     try:
         # Peek the resume point first: the feeder must start at the
@@ -301,6 +316,7 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             batches=batches, checkpoint_every=cfg.train_checkpoint_every,
             prepare=functools.partial(shard_tree, mesh),
             on_step=on_step, checkpoint_dir=cfg.checkpoint_dir,
+            mesh=mesh if tcfg.needs_mesh else None,
         )
         elapsed_ms = (time.perf_counter() - start) * 1000.0
     except Exception as e:
@@ -325,23 +341,12 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
 def train_model_config(cfg: RuntimeConfig):
     """The train payload's model, derived from the runtime config.
 
-    One definition shared by ``train`` and ``serve`` so the serving
-    payload restores exactly the architecture training checkpointed —
-    a drift here would surface as an orbax tree-structure mismatch.
+    One definition shared by ``train`` and ``serve`` (via
+    :func:`derive_model_config`) so the serving payload restores exactly
+    the architecture training checkpointed — a drift here would surface
+    as an orbax tree-structure mismatch.
     """
-    from kvedge_tpu.models import TransformerConfig
-    from kvedge_tpu.parallel.mesh import build_mesh
-
-    mesh = build_mesh(cfg.mesh)
-    axis_sizes = dict(mesh.shape)
-    return TransformerConfig(
-        vocab=PROBE_VOCAB,
-        d_model=PROBE_D_MODEL,
-        n_heads=max(4, axis_sizes.get("model", 1)),
-        n_layers=PROBE_LAYERS,
-        d_ff=4 * PROBE_D_MODEL,
-        max_seq=cfg.train_seq,
-    ), mesh
+    return derive_model_config(cfg, seq=cfg.train_seq)
 
 
 def run_serve_payload(cfg: RuntimeConfig):

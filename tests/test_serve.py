@@ -208,3 +208,54 @@ def test_http_generate_503_without_serve_payload(tmp_path):
         assert "serve" in doc["error"]
     finally:
         handle.shutdown()
+
+
+def test_expert_mesh_train_serve_agree_without_warning(tmp_path):
+    """The derived MoE config must be provably drop-free: train on an
+    expert mesh, serve from the checkpoint, and the endpoint must match
+    teacher forcing with NO divergence warning."""
+    import warnings
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kvedge_tpu.config.runtime_config import MeshSpec
+    from kvedge_tpu.data import write_corpus
+    from kvedge_tpu.models import forward
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+
+    corpus = tmp_path / "corpus.kvfeed"
+    rng = np.random.default_rng(13)
+    write_corpus(corpus, rng.integers(0, 512, size=3000, dtype=np.int32))
+    mesh_spec = MeshSpec(axes=(("data", 2), ("expert", 4)))
+
+    result = run_train_payload(_cfg(
+        tmp_path, payload="train", train_corpus=str(corpus),
+        train_steps=2, train_batch=8, train_checkpoint_every=2,
+        mesh=mesh_spec,
+    ))
+    assert result.ok, result.error
+
+    serve_cfg = _cfg(tmp_path, mesh=mesh_spec)
+    tcfg, _ = train_model_config(serve_cfg)
+    assert tcfg.expert_capacity_factor * tcfg.expert_top_k >= tcfg.n_experts
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        check, serve_fn = run_serve_payload(serve_cfg)
+        assert check.ok, check.error
+        out = serve_fn({"tokens": [[3, 1, 4]], "n_new": 2})
+    assert out["restored_step"] == 2
+
+    with StateCheckpointer(serve_cfg.state_dir) as ckpt:
+        _, tree = ckpt.restore_latest()
+    so_far = jnp.asarray([[3, 1, 4]], jnp.int32)
+    for _ in range(2):
+        nxt = jnp.argmax(
+            forward(tree["params"], so_far, tcfg)[:, -1], axis=-1
+        )
+        so_far = jnp.concatenate(
+            [so_far, nxt[:, None].astype(jnp.int32)], axis=1
+        )
+    np.testing.assert_array_equal(np.asarray(out["tokens"]),
+                                  np.asarray(so_far))
