@@ -59,6 +59,22 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
     model_axis = axis_sizes.get("model", 1)
     sp = axis_sizes.get("seq", 1)
     attention = cfg.payload_attention or ("ring" if sp > 1 else "naive")
+    if sp > 1 and attention not in ("ring", "ulysses"):
+        # The old data x model-only guard existed to keep mesh axes from
+        # being SILENTLY ignored; an explicit [payload] attention override
+        # must not reopen that hole — a seq axis with local attention
+        # would train replicas and report success.
+        raise MeshConfigError(
+            f"mesh declares a 'seq' axis but [payload] attention = "
+            f"{attention!r} would silently ignore it (the axis devices "
+            "would hold replicas); use attention = \"ring\"/\"ulysses\" "
+            "or drop the seq axis"
+        )
+    if sp == 1 and attention in ("ring", "ulysses"):
+        raise MeshConfigError(
+            f"[payload] attention = {attention!r} is sequence-parallel "
+            "and needs a 'seq' axis in the mesh"
+        )
     n_heads = max(4, model_axis)
     if attention == "ulysses" and n_heads % sp:
         # Ulysses scatters heads over the seq axis: round up to the next

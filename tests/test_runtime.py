@@ -574,3 +574,22 @@ def test_train_payload_rejects_stage_seq_mesh(tmp_path):
     ))
     assert not result.ok
     assert "does not compose" in result.error
+
+
+@pytest.mark.parametrize("attention,axes,fragment", [
+    # Explicit local attention must not silently ignore a seq axis.
+    ("naive", (("data", 2), ("seq", 4)), "silently ignore"),
+    ("flash", (("data", 2), ("seq", 4)), "silently ignore"),
+    # Sequence-parallel attention without a seq axis is equally wrong.
+    ("ring", (("data", 8),), "needs a 'seq' axis"),
+])
+def test_train_payload_rejects_ignored_or_impossible_attention(
+        tmp_path, attention, axes, fragment):
+    corpus = _write_train_corpus(tmp_path)
+    result = run_train_payload(_cfg(
+        tmp_path, payload="train", train_corpus=corpus, train_steps=2,
+        train_batch=8, train_seq=16, payload_attention=attention,
+        mesh=MeshSpec(axes=axes),
+    ))
+    assert not result.ok
+    assert fragment in result.error

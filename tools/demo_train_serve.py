@@ -1,0 +1,76 @@
+"""Demo step: resumable training -> checkpoint -> serving, one state volume.
+
+Driven by tools/record_demo.py for the asciinema cast: actually runs the
+``train`` payload (real feeder, real orbax checkpoints) and then the
+``serve`` payload against the SAME state directory, proving the restored
+step and a live generation — the round-2 half of the end-to-end story
+(the resilience drill in demo_cluster.py is the round-1 half).
+
+Usage: python tools/demo_train_serve.py <corpus.kvfeed>
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    corpus = sys.argv[1]
+    # The cast is a COMMITTED artifact: library warnings (e.g. orbax's
+    # restore-topology UserWarning, which embeds the recording machine's
+    # site-packages path) would bake environment-specific noise into it
+    # and churn the file on every regeneration.
+    import warnings
+
+    warnings.simplefilter("ignore")
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.runtime.workload import (
+        run_serve_payload,
+        run_train_payload,
+    )
+
+    state_dir = os.path.join(os.path.dirname(os.path.abspath(corpus)),
+                             "state")
+    base = dataclasses.replace(
+        RuntimeConfig(),
+        name="edge-tpu-demo",
+        state_dir=state_dir,
+        expected_platform="cpu",
+        status_port=0,
+        status_bind="127.0.0.1",
+        train_corpus=os.path.abspath(corpus),
+        train_steps=4,
+        train_batch=8,
+        train_seq=16,
+        train_checkpoint_every=2,
+    )
+
+    print("training 4 steps (checkpoint every 2) through the state volume...")
+    result = run_train_payload(dataclasses.replace(base, payload="train"))
+    if not result.ok:
+        print(f"train payload failed: {result.error}")
+        return 1
+    print(f"train payload ok; final loss {result.probe_checksum:.3f}")
+
+    print("booting the serve payload against the same state volume...")
+    check, serve_fn = run_serve_payload(
+        dataclasses.replace(base, payload="serve")
+    )
+    if not check.ok:
+        print(f"serve payload failed: {check.error}")
+        return 1
+    out = serve_fn({"tokens": [[5, 9, 2, 7]], "n_new": 6})
+    print(f"POST /generate -> restored_step={out['restored_step']} "
+          f"tokens={out['tokens'][0]}")
+    print("serving the trained checkpoint: restored_step matches the "
+          "training target")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

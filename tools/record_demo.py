@@ -128,6 +128,10 @@ def main() -> int:
          "# fake-cluster deploy + node-failure drill",
          [python, os.path.join(REPO, "tools", "demo_cluster.py"),
           "manifests"]),
+        ("python tools/demo_train_serve.py corpus.kvfeed  "
+         "# train -> checkpoint -> serve, one state volume",
+         [python, os.path.join(REPO, "tools", "demo_train_serve.py"),
+          "corpus.kvfeed"]),
         ("python -m kvedge_tpu notes",
          [python, "-m", "kvedge_tpu", "notes"]),
     ]
