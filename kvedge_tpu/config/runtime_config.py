@@ -32,8 +32,8 @@ DEFAULT_CONFIG_PATH = "/etc/kvedge/config.toml"
 DEFAULT_STATE_DIR = "/var/lib/kvedge/state"
 
 _VALID_PAYLOADS = (
-    "devicecheck", "transformer-probe", "inference-probe", "train", "serve",
-    "none",
+    "devicecheck", "transformer-probe", "inference-probe", "train", "eval",
+    "serve", "none",
 )
 # "" = auto (ring iff the mesh declares a seq axis); the rest match
 # TransformerConfig.attention (models/transformer.py).
@@ -270,10 +270,11 @@ class RuntimeConfig:
                 f"[payload] attention must be one of {_VALID_ATTENTION}, "
                 f"got {self.payload_attention!r}"
             )
-        if self.payload == "train" and not self.train_corpus:
+        if self.payload in ("train", "eval") and not self.train_corpus:
             raise RuntimeConfigError(
-                "[payload] kind = 'train' requires corpus = '<path>' "
-                "(a KVFEED01 token file, typically on the state volume)"
+                f"[payload] kind = {self.payload!r} requires corpus = "
+                "'<path>' (a KVFEED01 token file, typically on the state "
+                "volume)"
             )
         for field_name in ("train_steps", "train_batch", "train_seq",
                            "train_checkpoint_every"):

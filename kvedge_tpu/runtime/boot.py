@@ -143,6 +143,10 @@ def _run_payload(cfg: RuntimeConfig,
             from kvedge_tpu.runtime.workload import run_train_payload
 
             return run_train_payload(cfg)
+        if cfg.payload == "eval":
+            from kvedge_tpu.runtime.workload import run_eval_payload
+
+            return run_eval_payload(cfg)
         if cfg.payload == "serve":
             from kvedge_tpu.runtime.workload import run_serve_payload
 

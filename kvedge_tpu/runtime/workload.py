@@ -70,7 +70,11 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
             "would hold replicas); use attention = \"ring\"/\"ulysses\" "
             "or drop the seq axis"
         )
-    if sp == 1 and attention in ("ring", "ulysses"):
+    if "seq" not in axis_sizes and attention in ("ring", "ulysses"):
+        # Presence, not size: a seq axis that resolves to 1 on a small
+        # deployment still exists in the mesh, and the degenerate
+        # one-shard ring runs fine — the same templated config must boot
+        # across deployment sizes.
         raise MeshConfigError(
             f"[payload] attention = {attention!r} is sequence-parallel "
             "and needs a 'seq' axis in the mesh"
@@ -365,6 +369,168 @@ def train_model_config(cfg: RuntimeConfig):
     return derive_model_config(cfg, seq=cfg.train_seq)
 
 
+def _restore_latest_params(cfg: RuntimeConfig, tcfg):
+    """(step | None, params) from the latest checkpoint, or the fresh
+    deterministic init when the volume has none.
+
+    Shared by ``eval`` and ``serve``: the abstract tree MUST mirror
+    models/training.py's ``fresh_state`` exactly (params AND optimizer
+    state, seed 0) — that is the structure orbax wrote, and drift
+    surfaces only as a tree-structure mismatch at restore time, so there
+    is exactly one definition of it outside the trainer.
+    """
+    import jax
+
+    from kvedge_tpu.models import init_params, make_train_step
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+
+    init_opt, _ = make_train_step(tcfg)
+
+    def fresh_state():
+        p = init_params(jax.random.PRNGKey(0), tcfg)
+        return {"params": p, "opt_state": init_opt(p)}
+
+    with StateCheckpointer(
+        cfg.state_dir, checkpoint_dir=cfg.checkpoint_dir
+    ) as ckpt:
+        restored = ckpt.restore_latest(jax.eval_shape(fresh_state))
+    if restored is not None:
+        step, tree = restored
+        return step, tree["params"]
+    # fresh_state stays abstract — materializing it would allocate the
+    # optimizer moments only to discard them.
+    return None, init_params(jax.random.PRNGKey(0), tcfg)
+
+
+def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
+    """The ``eval`` payload: held-out loss for the checkpointed model.
+
+    The measurement half of the train/eval/serve loop: restores the
+    latest checkpoint exactly like ``serve`` does (same derived model,
+    same state tree) and computes the mean next-token cross-entropy over
+    ``[payload] steps`` deterministic batches of ``corpus`` — no
+    gradients, no optimizer, nothing written. The loss lands in
+    ``probe_checksum`` (and therefore /status and the heartbeat), so an
+    operator can read a checkpoint's quality from the same surface that
+    reports everything else. Use a held-out corpus file for honest
+    numbers; the batch order is the feeder's deterministic order from
+    batch 0.
+    """
+    base = run_device_check(cfg)
+    if not base.ok:
+        return base
+
+    import dataclasses
+    import functools
+    import math
+
+    import jax
+    import numpy as np
+
+    from kvedge_tpu.data import open_feeder
+    from kvedge_tpu.models import loss_fn
+    from kvedge_tpu.parallel import shard_batch, shard_params
+
+    # Same config prechecks as the train payload, for the same reason: a
+    # clear message at /status beats an opaque sharding traceback.
+    axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
+    data_size = axis_sizes.get("data", 1)
+    if cfg.train_batch % max(1, data_size):
+        return dataclasses.replace(
+            base, ok=False,
+            error=(
+                f"[payload] batch = {cfg.train_batch} must divide by the "
+                f"mesh's data axis size ({data_size}) — it is the global "
+                "batch, sharded across data-parallel devices"
+            ),
+        )
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        if not cfg.checkpoint_dir:
+            return dataclasses.replace(
+                base, ok=False,
+                error=(
+                    "multi-host eval needs [runtime] checkpoint_dir on "
+                    "shared storage — the checkpoint being evaluated was "
+                    "written there (README 'Multi-host')"
+                ),
+            )
+        if cfg.train_batch % n_proc:
+            return dataclasses.replace(
+                base, ok=False,
+                error=(
+                    f"[payload] batch = {cfg.train_batch} must divide by "
+                    f"the process count ({n_proc}) for per-host feeding"
+                ),
+            )
+    local_rows = cfg.train_batch // n_proc
+    shard_offset = jax.process_index() * local_rows
+
+    feeder = None
+    try:
+        tcfg, mesh = train_model_config(cfg)
+        step, params = _restore_latest_params(cfg, tcfg)
+        params = shard_params(mesh, params)
+
+        # Pure next-token cross-entropy: zeroing the aux weight drops the
+        # MoE router's load-balancing term from the reported number —
+        # eval measures model quality, not the training regularizer.
+        eval_tcfg = dataclasses.replace(tcfg, moe_aux_weight=0.0)
+        eval_loss = jax.jit(functools.partial(
+            loss_fn, cfg=eval_tcfg,
+            mesh=mesh if tcfg.needs_mesh else None,
+        ))
+        feeder = open_feeder(
+            cfg.train_corpus, batch=local_rows, seq=cfg.train_seq,
+            global_batch=cfg.train_batch, shard_offset=shard_offset,
+        )
+        if n_proc > 1:
+            from jax.sharding import NamedSharding
+
+            from kvedge_tpu.parallel.sharding import batch_spec
+
+            sharding = NamedSharding(mesh, batch_spec(mesh))
+            global_shape = (cfg.train_batch, cfg.train_seq + 1)
+
+            def next_batch():
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(next(feeder)) % tcfg.vocab,
+                    global_shape,
+                )
+        else:
+            def next_batch():
+                return shard_batch(mesh, next(feeder) % tcfg.vocab)
+
+        start = time.perf_counter()
+        total = 0.0
+        for _ in range(cfg.train_steps):
+            total += float(eval_loss(params, next_batch()))
+        mean_loss = total / cfg.train_steps
+        elapsed_ms = (time.perf_counter() - start) * 1000.0
+        print(
+            f"[kvedge-eval] checkpoint_step={step} batches="
+            f"{cfg.train_steps} loss={mean_loss:.4f} "
+            f"ppl={math.exp(min(mean_loss, 30.0)):.2f}",
+            flush=True,
+        )
+    except MeshConfigError as e:
+        return dataclasses.replace(base, ok=False, error=str(e))
+    except Exception as e:
+        return dataclasses.replace(
+            base, ok=False, error=f"eval payload failed: {e!r}",
+        )
+    finally:
+        if feeder is not None:
+            feeder.close()
+    if not math.isfinite(mean_loss):
+        return dataclasses.replace(
+            base, ok=False, error=f"eval loss is {mean_loss}",
+        )
+    return dataclasses.replace(
+        base, probe_ms=elapsed_ms, probe_checksum=mean_loss,
+    )
+
+
 def run_serve_payload(cfg: RuntimeConfig):
     """The ``serve`` payload: greedy decode behind ``POST /generate``.
 
@@ -394,38 +560,13 @@ def run_serve_payload(cfg: RuntimeConfig):
     import threading
     import time as time_mod
 
-    import jax
     import jax.numpy as jnp
 
-    from kvedge_tpu.models import generate, init_params
-    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+    from kvedge_tpu.models import generate
 
     try:
         tcfg, _ = train_model_config(cfg)
-        # Mirror the training driver's state tree exactly (params AND
-        # optimizer state, seed 0 — models/training.py fresh_state): the
-        # checkpoint was written with that structure, and restore needs
-        # the same abstract tree to reassemble it.
-        from kvedge_tpu.models import make_train_step
-
-        init_opt, _ = make_train_step(tcfg)
-
-        def fresh_state():
-            p = init_params(jax.random.PRNGKey(0), tcfg)
-            return {"params": p, "opt_state": init_opt(p)}
-
-        restored_step = None
-        with StateCheckpointer(
-            cfg.state_dir, checkpoint_dir=cfg.checkpoint_dir
-        ) as ckpt:
-            restored = ckpt.restore_latest(jax.eval_shape(fresh_state))
-        if restored is not None:
-            restored_step, tree = restored
-            params = tree["params"]
-        else:
-            # fresh_state stays abstract (eval_shape) — materializing it
-            # here would allocate AdamW moment trees only to discard them.
-            params = init_params(jax.random.PRNGKey(0), tcfg)
+        restored_step, params = _restore_latest_params(cfg, tcfg)
 
         lock = threading.Lock()
 

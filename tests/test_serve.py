@@ -259,3 +259,90 @@ def test_expert_mesh_train_serve_agree_without_warning(tmp_path):
         )
     np.testing.assert_array_equal(np.asarray(out["tokens"]),
                                   np.asarray(so_far))
+
+
+# ---- eval payload --------------------------------------------------------
+
+
+def _eval_cfg(tmp_path, corpus, **overrides):
+    base = dict(payload="eval", train_corpus=str(corpus),
+                train_steps=3, train_batch=8)
+    base.update(overrides)
+    return _cfg(tmp_path, **base)
+
+
+def _make_corpus(tmp_path, seed=17):
+    from kvedge_tpu.data import write_corpus
+
+    corpus = tmp_path / "corpus.kvfeed"
+    rng = np.random.default_rng(seed)
+    write_corpus(corpus, rng.integers(0, 512, size=3000, dtype=np.int32))
+    return corpus
+
+
+def test_eval_payload_fresh_volume_near_ln_vocab(tmp_path):
+    import math
+
+    from kvedge_tpu.runtime.workload import run_eval_payload
+
+    corpus = _make_corpus(tmp_path)
+    result = run_eval_payload(_eval_cfg(tmp_path, corpus))
+    assert result.ok, result.error
+    # Untrained model on random tokens: loss ~ ln(512).
+    assert abs(result.probe_checksum - math.log(512)) < 0.5 * math.log(512)
+
+
+def test_eval_after_training_improves(tmp_path):
+    """Train on the corpus, then eval the checkpoint on the SAME corpus:
+    the restored loss must beat the fresh-init loss — proving eval reads
+    the trained weights, not the init."""
+    from kvedge_tpu.runtime.workload import run_eval_payload
+
+    corpus = _make_corpus(tmp_path)
+    fresh = run_eval_payload(_eval_cfg(tmp_path, corpus))
+    assert fresh.ok, fresh.error
+
+    train = run_train_payload(_cfg(
+        tmp_path, payload="train", train_corpus=str(corpus),
+        train_steps=6, train_batch=8, train_checkpoint_every=3,
+    ))
+    assert train.ok, train.error
+
+    trained = run_eval_payload(_eval_cfg(tmp_path, corpus))
+    assert trained.ok, trained.error
+    assert trained.probe_checksum < fresh.probe_checksum
+
+
+def test_eval_requires_corpus():
+    from kvedge_tpu.config.runtime_config import (
+        RuntimeConfig,
+        RuntimeConfigError,
+    )
+
+    with pytest.raises(RuntimeConfigError, match="corpus"):
+        RuntimeConfig.parse('[payload]\nkind = "eval"\n')
+
+
+def test_eval_multihost_requires_shared_checkpoint_dir(tmp_path, monkeypatch):
+    import jax
+
+    from kvedge_tpu.runtime.workload import run_eval_payload
+
+    corpus = _make_corpus(tmp_path)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    result = run_eval_payload(_eval_cfg(tmp_path, corpus))
+    assert not result.ok
+    assert "checkpoint_dir" in result.error and "shared storage" in result.error
+
+
+def test_eval_reports_clear_error_for_indivisible_batch(tmp_path):
+    from kvedge_tpu.config.runtime_config import MeshSpec
+    from kvedge_tpu.runtime.workload import run_eval_payload
+
+    corpus = _make_corpus(tmp_path)
+    result = run_eval_payload(_eval_cfg(
+        tmp_path, corpus, train_batch=7,
+        mesh=MeshSpec(axes=(("data", 8),)),
+    ))
+    assert not result.ok
+    assert "must divide" in result.error
