@@ -227,43 +227,10 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     from kvedge_tpu.runtime import heartbeat
     from kvedge_tpu.runtime.checkpoint import StateCheckpointer
 
-    axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
-    data_size = axis_sizes.get("data", 1)
-    if cfg.train_batch % max(1, data_size):
-        return dataclasses.replace(
-            base, ok=False,
-            error=(
-                f"[payload] batch = {cfg.train_batch} must divide by the "
-                f"mesh's data axis size ({data_size}) — it is the global "
-                "batch, sharded across data-parallel devices"
-            ),
-        )
-    # Multi-host slice: every process feeds its own shard of the global
-    # batch (per-host feeder offsets) and assembles the global array from
-    # process-local data. Checkpoints must live on storage every host can
-    # reach — per-host PVCs cannot hold a slice-wide checkpoint.
-    n_proc = jax.process_count()
-    if n_proc > 1:
-        if not cfg.checkpoint_dir:
-            return dataclasses.replace(
-                base, ok=False,
-                error=(
-                    "multi-host train needs [runtime] checkpoint_dir on "
-                    "shared storage (a shared-filesystem mount or "
-                    "gs://bucket/prefix): per-host PVCs cannot hold a "
-                    "slice-wide checkpoint (README 'Multi-host')"
-                ),
-            )
-        if cfg.train_batch % n_proc:
-            return dataclasses.replace(
-                base, ok=False,
-                error=(
-                    f"[payload] batch = {cfg.train_batch} must divide by "
-                    f"the process count ({n_proc}) for per-host feeding"
-                ),
-            )
-    local_rows = cfg.train_batch // n_proc
-    shard_offset = jax.process_index() * local_rows
+    error, geometry = _feed_geometry(cfg, base, "train")
+    if error is not None:
+        return error
+    local_rows, shard_offset, n_proc = geometry
     # The model derives from the mesh exactly like the probe's (seq axis
     # -> sequence-parallel attention, expert -> MoE, stage -> pipelined
     # layers): every mesh family the probe exercises, training trains.
@@ -284,27 +251,7 @@ def run_train_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             start_batch=resume_step, global_batch=cfg.train_batch,
             shard_offset=shard_offset,
         )
-        # The payload model is compact (vocab 512); fold arbitrary token
-        # ids into range rather than letting the embedding lookup clamp
-        # them silently. Deterministic, so resume stays exact. Every
-        # batch and the (fresh or restored) state shard onto the mesh.
-        if n_proc > 1:
-            from jax.sharding import NamedSharding
-
-            from kvedge_tpu.parallel.sharding import batch_spec
-
-            sharding = NamedSharding(mesh, batch_spec(mesh))
-            global_shape = (cfg.train_batch, cfg.train_seq + 1)
-            batches = (
-                jax.make_array_from_process_local_data(
-                    sharding, np.asarray(batch) % tcfg.vocab, global_shape
-                )
-                for batch in feeder
-            )
-        else:
-            batches = (
-                shard_batch(mesh, batch % tcfg.vocab) for batch in feeder
-            )
+        batches = _global_batches(cfg, tcfg, mesh, feeder, n_proc)
 
         last_write = 0.0
 
@@ -369,6 +316,80 @@ def train_model_config(cfg: RuntimeConfig):
     return derive_model_config(cfg, seq=cfg.train_seq)
 
 
+def _feed_geometry(cfg: RuntimeConfig, base: DeviceCheckResult, kind: str):
+    """Shared prechecks + per-host feed geometry for corpus payloads.
+
+    Returns ``(error_result | None, (local_rows, shard_offset, n_proc))``.
+    One definition for ``train`` and ``eval`` so the two can never
+    disagree on batch/mesh divisibility rules or multi-host requirements
+    — a clear message at /status beats an opaque sharding traceback.
+    """
+    import dataclasses
+
+    import jax
+
+    axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
+    data_size = axis_sizes.get("data", 1)
+    if cfg.train_batch % max(1, data_size):
+        return dataclasses.replace(
+            base, ok=False,
+            error=(
+                f"[payload] batch = {cfg.train_batch} must divide by the "
+                f"mesh's data axis size ({data_size}) — it is the global "
+                "batch, sharded across data-parallel devices"
+            ),
+        ), None
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        if not cfg.checkpoint_dir:
+            return dataclasses.replace(
+                base, ok=False,
+                error=(
+                    f"multi-host {kind} needs [runtime] checkpoint_dir "
+                    "on shared storage (a shared-filesystem mount or "
+                    "gs://bucket/prefix): per-host PVCs cannot hold a "
+                    "slice-wide checkpoint (README 'Multi-host')"
+                ),
+            ), None
+        if cfg.train_batch % n_proc:
+            return dataclasses.replace(
+                base, ok=False,
+                error=(
+                    f"[payload] batch = {cfg.train_batch} must divide by "
+                    f"the process count ({n_proc}) for per-host feeding"
+                ),
+            ), None
+    local_rows = cfg.train_batch // n_proc
+    return None, (local_rows, jax.process_index() * local_rows, n_proc)
+
+
+def _global_batches(cfg: RuntimeConfig, tcfg, mesh, feeder, n_proc: int):
+    """Iterator of sharded global [B, T+1] batches from a (possibly
+    host-sharded) feeder. Token ids fold into the payload vocab (% V):
+    deterministic, so resume stays exact. Single definition for ``train``
+    and ``eval`` — how batches are assembled is part of the resume
+    contract and must not fork."""
+    import jax
+
+    from kvedge_tpu.parallel import shard_batch
+
+    if n_proc > 1:
+        import numpy as np
+        from jax.sharding import NamedSharding
+
+        from kvedge_tpu.parallel.sharding import batch_spec
+
+        sharding = NamedSharding(mesh, batch_spec(mesh))
+        global_shape = (cfg.train_batch, cfg.train_seq + 1)
+        for batch in feeder:
+            yield jax.make_array_from_process_local_data(
+                sharding, np.asarray(batch) % tcfg.vocab, global_shape
+            )
+    else:
+        for batch in feeder:
+            yield shard_batch(mesh, batch % tcfg.vocab)
+
+
 def _restore_latest_params(cfg: RuntimeConfig, tcfg):
     """(step | None, params) from the latest checkpoint, or the fresh
     deterministic init when the volume has none.
@@ -431,40 +452,10 @@ def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     from kvedge_tpu.models import loss_fn
     from kvedge_tpu.parallel import shard_batch, shard_params
 
-    # Same config prechecks as the train payload, for the same reason: a
-    # clear message at /status beats an opaque sharding traceback.
-    axis_sizes = dict(zip(base.mesh_axes, base.mesh_shape))
-    data_size = axis_sizes.get("data", 1)
-    if cfg.train_batch % max(1, data_size):
-        return dataclasses.replace(
-            base, ok=False,
-            error=(
-                f"[payload] batch = {cfg.train_batch} must divide by the "
-                f"mesh's data axis size ({data_size}) — it is the global "
-                "batch, sharded across data-parallel devices"
-            ),
-        )
-    n_proc = jax.process_count()
-    if n_proc > 1:
-        if not cfg.checkpoint_dir:
-            return dataclasses.replace(
-                base, ok=False,
-                error=(
-                    "multi-host eval needs [runtime] checkpoint_dir on "
-                    "shared storage — the checkpoint being evaluated was "
-                    "written there (README 'Multi-host')"
-                ),
-            )
-        if cfg.train_batch % n_proc:
-            return dataclasses.replace(
-                base, ok=False,
-                error=(
-                    f"[payload] batch = {cfg.train_batch} must divide by "
-                    f"the process count ({n_proc}) for per-host feeding"
-                ),
-            )
-    local_rows = cfg.train_batch // n_proc
-    shard_offset = jax.process_index() * local_rows
+    error, geometry = _feed_geometry(cfg, base, "eval")
+    if error is not None:
+        return error
+    local_rows, shard_offset, n_proc = geometry
 
     feeder = None
     try:
@@ -484,27 +475,11 @@ def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             cfg.train_corpus, batch=local_rows, seq=cfg.train_seq,
             global_batch=cfg.train_batch, shard_offset=shard_offset,
         )
-        if n_proc > 1:
-            from jax.sharding import NamedSharding
-
-            from kvedge_tpu.parallel.sharding import batch_spec
-
-            sharding = NamedSharding(mesh, batch_spec(mesh))
-            global_shape = (cfg.train_batch, cfg.train_seq + 1)
-
-            def next_batch():
-                return jax.make_array_from_process_local_data(
-                    sharding, np.asarray(next(feeder)) % tcfg.vocab,
-                    global_shape,
-                )
-        else:
-            def next_batch():
-                return shard_batch(mesh, next(feeder) % tcfg.vocab)
-
+        batches = _global_batches(cfg, tcfg, mesh, feeder, n_proc)
         start = time.perf_counter()
         total = 0.0
         for _ in range(cfg.train_steps):
-            total += float(eval_loss(params, next_batch()))
+            total += float(eval_loss(params, next(batches)))
         mean_loss = total / cfg.train_steps
         elapsed_ms = (time.perf_counter() - start) * 1000.0
         print(

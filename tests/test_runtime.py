@@ -525,6 +525,7 @@ def test_train_payload_multihost_requires_shared_checkpoint_dir(
     ((("data", 2), ("seq", 4)), "seq-ring"),
     ((("data", 2), ("expert", 4)), "expert"),
     ((("data", 2), ("stage", 4)), "stage"),
+    ((("data", 2), ("seq", 2), ("expert", 2)), "seq-x-expert"),
 ])
 def test_train_payload_runs_on_all_mesh_families(tmp_path, axes, label):
     """VERDICT r1 weak #2: parallelism that only ran in the probe now

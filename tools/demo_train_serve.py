@@ -21,7 +21,7 @@ sys.path.insert(0, REPO)
 
 def main() -> int:
     if len(sys.argv) != 2:
-        print(__doc__.strip().splitlines()[-1])  # the Usage line
+        print("Usage: python tools/demo_train_serve.py <corpus.kvfeed>")
         return 1
     corpus = sys.argv[1]
     # The cast is a COMMITTED artifact: library warnings (e.g. orbax's
