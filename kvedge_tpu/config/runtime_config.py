@@ -161,6 +161,12 @@ class RuntimeConfig:
     # select a specific sequence-parallel strategy ("ring"/"ulysses") or
     # kernel ("flash"/"naive").
     payload_attention: str = ""
+    # Decode backend for the "serve" payload. "" / "contiguous" = one
+    # uniform-batch cache per request (simple, request-serial); "paged" =
+    # the continuous-batching server over the paged KV cache
+    # (models/serving.py): concurrent requests share one page pool and
+    # one batched decode step.
+    payload_serving: str = ""
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -232,6 +238,9 @@ class RuntimeConfig:
                 payload_attention=str(
                     payload_doc.get("attention", cls.payload_attention)
                 ),
+                payload_serving=str(
+                    payload_doc.get("serving", cls.payload_serving)
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -269,6 +278,11 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 f"[payload] attention must be one of {_VALID_ATTENTION}, "
                 f"got {self.payload_attention!r}"
+            )
+        if self.payload_serving not in ("", "contiguous", "paged"):
+            raise RuntimeConfigError(
+                "[payload] serving must be '', 'contiguous', or 'paged', "
+                f"got {self.payload_serving!r}"
             )
         if self.payload in ("train", "eval") and not self.train_corpus:
             raise RuntimeConfigError(
@@ -318,6 +332,7 @@ class RuntimeConfig:
             "\n[payload]\n"
             f"kind = {s(self.payload)}\n"
             f"attention = {s(self.payload_attention)}\n"
+            f"serving = {s(self.payload_serving)}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"steps = {self.train_steps}\n"
             f"batch = {self.train_batch}\n"

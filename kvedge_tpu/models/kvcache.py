@@ -104,6 +104,9 @@ class PagedKVCache:
     def free_pages(self) -> int:
         return len(self._free)
 
+    def is_admitted(self, slot: int) -> bool:
+        return slot in self._pages_of
+
     def admit(self, slot: int, prompt_len: int) -> None:
         """Reserve pages for a prompt landing in ``slot``."""
         if slot in self._pages_of:

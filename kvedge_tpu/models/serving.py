@@ -1,0 +1,233 @@
+"""Continuous-batching generation server over the paged KV cache.
+
+The request-level serving loop the paged cache (models/kvcache.py) exists
+for: many concurrent requests with different prompt lengths and budgets
+share one page pool and ONE batched decode step. A request joins
+mid-stream (admit + per-sequence prefill into a free slot), rides the
+batched ``step`` with whatever else is in flight, and leaves when its
+budget is done (pages released back to the pool) — no request ever waits
+for another to finish, which is the whole point of continuous batching
+over static batches.
+
+TPU-first split, same as the cache it wraps: the decode loop is one
+batched jitted step over all ``slots`` regardless of occupancy (static
+shapes, no retracing as requests come and go); admission, slot
+assignment, and page-budget reservation are host-side Python under one
+lock. Greedy decode here agrees token-for-token with the contiguous
+:func:`~kvedge_tpu.models.decode.generate` — the paged attention math
+matches decode.py exactly, and tests/test_serving.py pins the
+equivalence under concurrency.
+
+The reference has no serving of any kind (SURVEY.md §0); this is the
+capability the repo's own README listed as future work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+
+class ServerBusy(RuntimeError):
+    """No slot/page capacity became available within the timeout."""
+
+
+class ServerClosed(RuntimeError):
+    """The server was shut down."""
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: list[int]
+    n_new: int
+    next_token: int = -1
+    generated: list[int] = dataclasses.field(default_factory=list)
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    error: Exception | None = None
+
+
+class PagedGenerationServer:
+    """Greedy continuous-batching decode over a :class:`PagedKVCache`.
+
+    ``submit`` blocks the calling thread until its tokens are ready (the
+    HTTP handler model); the single background decode thread advances
+    every in-flight request one token per batched step. Admission
+    reserves each request's WORST-CASE page budget
+    (``ceil((prompt + n_new) / page_size)``) up front, so ``grow`` can
+    never exhaust the pool mid-decode — a request either gets capacity
+    at admission or waits/queues, it never dies halfway.
+    """
+
+    def __init__(self, params: dict, cfg, *, slots: int = 4,
+                 pages: int = 64, page_size: int = 16):
+        from kvedge_tpu.models.kvcache import PagedKVCache
+
+        self._params = params
+        self._cfg = cfg
+        self._cache = PagedKVCache(
+            cfg, slots=slots, pages=pages, page_size=page_size
+        )
+        self._pages_total = pages
+        self._reserved = 0  # worst-case pages of every in-flight request
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._active: dict[int, _Request] = {}
+        self._free_slots = list(range(slots))[::-1]
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="kvedge-paged-serve", daemon=True
+        )
+        self._thread.start()
+
+    # ---- public API ------------------------------------------------------
+
+    def submit(self, prompt: list[int], n_new: int,
+               timeout: float = 120.0) -> list[int]:
+        """Blocking generate: returns ``prompt + n_new`` greedy tokens.
+
+        Raises :class:`ServerBusy` when capacity doesn't free up within
+        ``timeout``, ValueError for requests that can never fit.
+        """
+        if not prompt or n_new < 1:
+            raise ValueError("need a non-empty prompt and n_new >= 1")
+        total = len(prompt) + n_new
+        if total > self._cfg.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + n_new ({n_new}) exceeds the "
+                f"model's max_seq ({self._cfg.max_seq})"
+            )
+        pages_needed = -(-total // self._cache.page_size)
+        if pages_needed > self._cache.max_pages_per_seq:
+            raise ValueError(
+                f"request needs {pages_needed} pages > max_pages_per_seq "
+                f"= {self._cache.max_pages_per_seq}"
+            )
+        if pages_needed > self._pages_total:
+            raise ValueError(
+                f"request needs {pages_needed} pages > pool size "
+                f"{self._pages_total}"
+            )
+
+        import jax.numpy as jnp
+
+        req = _Request(prompt=list(prompt), n_new=n_new)
+        deadline = time.monotonic() + timeout
+        with self._work:
+            while (not self._closed
+                   and (not self._free_slots
+                        or self._reserved + pages_needed
+                        > self._pages_total)):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ServerBusy(
+                        "no slot/page capacity within the timeout "
+                        f"({len(self._active)} requests in flight)"
+                    )
+                self._work.wait(timeout=remaining)
+            if self._closed:
+                raise ServerClosed("server is shut down")
+            slot = self._free_slots.pop()
+            self._reserved += pages_needed
+            try:
+                # Prefill under the lock: it mutates cache state the step
+                # loop reads. Per-sequence prefill compiles once per
+                # distinct prompt length (static shapes).
+                self._cache.admit(slot, len(req.prompt))
+                logits = self._cache.prefill(
+                    self._params, slot, jnp.asarray(req.prompt, jnp.int32)
+                )
+                req.next_token = int(jnp.argmax(logits))
+            except Exception:
+                self._release_locked(slot, pages_needed)
+                raise
+            self._active[slot] = req
+            self._work.notify_all()  # wake the decode loop
+
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        return req.prompt + req.generated
+
+    def close(self) -> None:
+        with self._work:
+            self._closed = True
+            self._work.notify_all()
+        self._thread.join(timeout=30)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "in_flight": len(self._active),
+                "free_slots": len(self._free_slots),
+                "free_pages": self._cache.free_pages(),
+                "reserved_pages": self._reserved,
+            }
+
+    # ---- decode loop -----------------------------------------------------
+
+    def _release_locked(self, slot: int, pages_needed: int) -> None:
+        """Return a slot + its reservation to the pool (lock held)."""
+        if self._cache.is_admitted(slot):
+            self._cache.release(slot)
+        self._free_slots.append(slot)
+        self._reserved -= pages_needed
+        self._work.notify_all()
+
+    def _pages_for(self, req: _Request) -> int:
+        return -(-(len(req.prompt) + req.n_new) // self._cache.page_size)
+
+    def _loop(self) -> None:
+        import jax.numpy as jnp
+
+        while True:
+            with self._work:
+                while not self._active and not self._closed:
+                    self._work.wait()
+                if self._closed:
+                    for req in self._active.values():
+                        req.error = ServerClosed("server shut down mid-"
+                                                 "request")
+                        req.done.set()
+                    self._active.clear()
+                    return
+                try:
+                    # A request whose pending token completes its budget
+                    # needs no step at all (the token is already known) —
+                    # finish it before the batch, the same discipline as
+                    # generate()'s n_new - 1 decode steps.
+                    for slot in list(self._active):
+                        req = self._active[slot]
+                        if len(req.generated) + 1 >= req.n_new:
+                            req.generated.append(req.next_token)
+                            del self._active[slot]
+                            self._release_locked(slot,
+                                                 self._pages_for(req))
+                            req.done.set()
+                    if not self._active:
+                        continue
+                    # Feed every active slot's pending token through ONE
+                    # batched step; inactive slots carry zeros (masked).
+                    tokens = np.zeros((self._cache.slots,), np.int32)
+                    for slot, req in self._active.items():
+                        tokens[slot] = req.next_token
+                    logits = self._cache.step(
+                        self._params, jnp.asarray(tokens)
+                    )
+                    for slot, req in self._active.items():
+                        req.generated.append(req.next_token)
+                        req.next_token = int(jnp.argmax(logits[slot]))
+                except Exception as e:  # poison: fail every waiter loudly
+                    for req in self._active.values():
+                        req.error = e
+                        req.done.set()
+                    self._active.clear()
+                    self._closed = True
+                    # Wake admission waiters so they fail fast with
+                    # ServerClosed instead of sleeping out their timeout.
+                    self._work.notify_all()
+                    return

@@ -543,6 +543,20 @@ def run_serve_payload(cfg: RuntimeConfig):
         tcfg, _ = train_model_config(cfg)
         restored_step, params = _restore_latest_params(cfg, tcfg)
 
+        paged_server = None
+        if cfg.payload_serving == "paged":
+            from kvedge_tpu.models.serving import PagedGenerationServer
+
+            # Pool sized so every slot can hold a worst-case request —
+            # admission then only ever waits on slots, never on pages.
+            # page_size passed explicitly so the sizing arithmetic and
+            # the cache's pages can never drift apart.
+            slots, page_size = 4, 16
+            pages = slots * -(-tcfg.max_seq // page_size)
+            paged_server = PagedGenerationServer(
+                params, tcfg, slots=slots, pages=pages,
+                page_size=page_size,
+            )
         lock = threading.Lock()
 
         def serve_fn(doc: dict) -> dict:
@@ -576,6 +590,48 @@ def run_serve_payload(cfg: RuntimeConfig):
                 # floats (1.9 -> 1) and decode a different prompt than
                 # the client sent.
                 raise ValueError("token rows must contain integers")
+            if paged_server is not None:
+                # Continuous batching: each row is its own request into
+                # the shared page pool, submitted CONCURRENTLY so the
+                # rows (and any other HTTP handlers' rows) ride the same
+                # batched decode step rather than decoding serially.
+                from kvedge_tpu.models.serving import (
+                    ServerBusy,
+                    ServerClosed,
+                )
+                from kvedge_tpu.runtime.status import GenerateUnavailable
+
+                rows: list = [None] * len(tokens)
+                errors: list = [None] * len(tokens)
+
+                def one_row(i, row):
+                    try:
+                        rows[i] = paged_server.submit(
+                            [t % tcfg.vocab for t in row], n_new
+                        )
+                    except Exception as e:
+                        errors[i] = e
+
+                workers = [
+                    threading.Thread(target=one_row, args=(i, row))
+                    for i, row in enumerate(tokens)
+                ]
+                for w in workers:
+                    w.start()
+                for w in workers:
+                    w.join()
+                for e in errors:
+                    if isinstance(e, (ServerBusy, ServerClosed)):
+                        # Retryable capacity condition, not a server
+                        # fault: surface as 503, not 500.
+                        raise GenerateUnavailable(str(e)) from e
+                    if e is not None:
+                        raise e
+                return {
+                    "tokens": rows,
+                    "n_new": n_new,
+                    "restored_step": restored_step,
+                }
             prompt = jnp.asarray(tokens, jnp.int32) % tcfg.vocab
             with lock:
                 out = generate(params, prompt, tcfg, n_new=n_new)

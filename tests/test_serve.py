@@ -346,3 +346,21 @@ def test_eval_reports_clear_error_for_indivisible_batch(tmp_path):
     ))
     assert not result.ok
     assert "must divide" in result.error
+
+
+def test_paged_serving_matches_contiguous(tmp_path):
+    """[payload] serving = 'paged' routes /generate through the
+    continuous-batching server; outputs must equal the contiguous path."""
+    contiguous_check, contiguous_fn = run_serve_payload(_cfg(tmp_path))
+    assert contiguous_check.ok, contiguous_check.error
+
+    paged_check, paged_fn = run_serve_payload(
+        _cfg(tmp_path, payload_serving="paged")
+    )
+    assert paged_check.ok, paged_check.error
+
+    req = {"tokens": [[5, 9, 2, 7], [1, 1, 4, 3]], "n_new": 5}
+    got = paged_fn(req)
+    want = contiguous_fn(req)
+    assert got["tokens"] == want["tokens"]
+    assert got["restored_step"] == want["restored_step"]
