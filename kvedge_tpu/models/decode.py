@@ -171,9 +171,58 @@ def decode_step(params: dict, cache: KVCache, tokens, cfg: TransformerConfig):
     return _run_layers(cfg, params, x, cache, cache.length)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n_new"))
-def generate(params: dict, prompt, cfg: TransformerConfig, n_new: int):
-    """Greedy-decode ``n_new`` tokens after a [B, T] prompt.
+def nucleus_filter(logits, temperature, top_p):
+    """Temperature-scale + top-p (nucleus) filter. logits [..., V] fp32.
+
+    Tokens outside the smallest probability mass >= ``top_p`` get -inf;
+    the highest-probability token always survives (top_p -> 0 degrades
+    to greedy). ONE definition shared by the contiguous scan and the
+    continuous-batching server, so the two backends sample identically
+    from identical logits — the cross-backend parity contract
+    (tests/test_sampling.py). ``temperature``/``top_p`` are traced
+    scalars: new values never recompile the serving loop.
+    """
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # Keep ranks whose PRECEDING mass is < top_p (the first rank always
+    # qualifies); map the rank cutoff back through a logit threshold.
+    keep = (cumulative - probs) < top_p
+    threshold = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(scaled >= threshold, scaled, -jnp.inf)
+
+
+def sample_token(logits, keys, temperature, top_p):
+    """One sampled token id per row. logits [B, V] fp32, ``keys`` one
+    PRNG key per row (each row owns its stream — batch composition must
+    not change any row's tokens)."""
+    filtered = nucleus_filter(logits, temperature, top_p)
+    return jax.vmap(jax.random.categorical)(keys, filtered).astype(
+        jnp.int32
+    )
+
+
+def row_sample_keys(seed_keys, step):
+    """The shared key schedule: token ``step`` of a row samples with
+    ``fold_in(row_seed, step)`` — a pure function of (row seed, token
+    index), independent of batch composition or backend."""
+    return jax.vmap(lambda k: jax.random.fold_in(k, step))(seed_keys)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_new", "sampled"))
+def generate(params: dict, prompt, cfg: TransformerConfig, n_new: int,
+             sampling=None, sampled: bool = False):
+    """Decode ``n_new`` tokens after a [B, T] prompt.
+
+    Greedy by default. With ``sampled=True``, ``sampling`` is a traced
+    ``(seed_keys [B], temperature scalar, top_p scalar)`` triple: token
+    ``t`` of row ``r`` samples from the nucleus-filtered logits with key
+    ``fold_in(seed_keys[r], t)``. Temperature/top_p/keys are traced, so
+    only the greedy/sampled CHOICE recompiles — not every request's
+    parameters.
 
     Returns [B, T + n_new] int32. The whole loop is one compiled program:
     prefill, then a ``lax.scan`` of donated decode steps.
@@ -182,16 +231,23 @@ def generate(params: dict, prompt, cfg: TransformerConfig, n_new: int):
     cache = init_cache(cfg, batch, max_seq=prompt_len + n_new)
     logits, cache = prefill(params, prompt, cache, cfg)
 
-    def step(carry, _):
+    def pick(logits, step):
+        if not sampled:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        seed_keys, temperature, top_p = sampling
+        keys = row_sample_keys(seed_keys, step)
+        return sample_token(logits, keys, temperature, top_p)
+
+    def step_fn(carry, step):
         cache, logits = carry
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        token = pick(logits, step)
         logits, cache = decode_step(params, cache, token, cfg)
         return (cache, logits), token
 
     # n_new - 1 cached steps; the final token falls out of the last carried
     # logits without paying for a decode step whose logits nobody reads.
     (_, logits), tokens = lax.scan(
-        step, (cache, logits), None, length=n_new - 1
+        step_fn, (cache, logits), jnp.arange(n_new - 1)
     )
-    last = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    last = pick(logits, n_new - 1)
     return jnp.concatenate([prompt, tokens.T, last[:, None]], axis=1)

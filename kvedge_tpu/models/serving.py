@@ -43,6 +43,10 @@ class ServerClosed(RuntimeError):
 class _Request:
     prompt: list[int]
     n_new: int
+    # (seed_key, temperature, top_p) or None for greedy. The key schedule
+    # is decode.py's: token t samples with fold_in(seed_key, t) — a pure
+    # function of the request, so batch composition changes nothing.
+    sampling: tuple | None = None
     next_token: int = -1
     generated: list[int] = dataclasses.field(default_factory=list)
     done: threading.Event = dataclasses.field(
@@ -50,9 +54,27 @@ class _Request:
     )
     error: Exception | None = None
 
+    def pick(self, logits_row, step: int) -> int:
+        """Next token from a [V] logits row, greedy or sampled. Used at
+        prefill (one row); the decode loop batches every slot's pick
+        into one device call instead (see ``_next_tokens``)."""
+        import jax.numpy as jnp
+
+        if self.sampling is None:
+            return int(jnp.argmax(logits_row))
+        from kvedge_tpu.models.decode import row_sample_keys, sample_token
+
+        seed_key, temperature, top_p = self.sampling
+        keys = row_sample_keys(seed_key[None], step)
+        return int(sample_token(
+            logits_row[None], keys, temperature, top_p
+        )[0])
+
 
 class PagedGenerationServer:
-    """Greedy continuous-batching decode over a :class:`PagedKVCache`.
+    """Continuous-batching decode over a :class:`PagedKVCache` — greedy
+    by default, per-request nucleus sampling via ``submit(sampling=...)``
+    (same key schedule and filter as the contiguous backend).
 
     ``submit`` blocks the calling thread until its tokens are ready (the
     HTTP handler model); the single background decode thread advances
@@ -87,10 +109,15 @@ class PagedGenerationServer:
     # ---- public API ------------------------------------------------------
 
     def submit(self, prompt: list[int], n_new: int,
-               timeout: float = 120.0) -> list[int]:
-        """Blocking generate: returns ``prompt + n_new`` greedy tokens.
+               timeout: float = 120.0, sampling: tuple | None = None
+               ) -> list[int]:
+        """Blocking generate: returns ``prompt + n_new`` tokens.
 
-        Raises :class:`ServerBusy` when capacity doesn't free up within
+        Greedy unless ``sampling = (seed_key, temperature, top_p)`` —
+        then token ``t`` samples with ``fold_in(seed_key, t)`` through
+        the same nucleus filter as the contiguous backend, so the two
+        produce identical tokens for identical requests. Raises
+        :class:`ServerBusy` when capacity doesn't free up within
         ``timeout``, ValueError for requests that can never fit.
         """
         if not prompt or n_new < 1:
@@ -115,7 +142,7 @@ class PagedGenerationServer:
 
         import jax.numpy as jnp
 
-        req = _Request(prompt=list(prompt), n_new=n_new)
+        req = _Request(prompt=list(prompt), n_new=n_new, sampling=sampling)
         deadline = time.monotonic() + timeout
         with self._work:
             while (not self._closed
@@ -141,7 +168,7 @@ class PagedGenerationServer:
                 logits = self._cache.prefill(
                     self._params, slot, jnp.asarray(req.prompt, jnp.int32)
                 )
-                req.next_token = int(jnp.argmax(logits))
+                req.next_token = req.pick(logits, 0)
             except Exception:
                 self._release_locked(slot, pages_needed)
                 raise
@@ -181,6 +208,49 @@ class PagedGenerationServer:
     def _pages_for(self, req: _Request) -> int:
         return -(-(len(req.prompt) + req.n_new) // self._cache.page_size)
 
+    def _next_tokens(self, logits) -> dict[int, int]:
+        """Every active slot's next token from the step's [slots, V]
+        logits — ONE batched argmax plus (when any request samples) ONE
+        batched fold_in/filter/categorical call and one host transfer,
+        instead of per-slot eager chains under the lock."""
+        import jax
+        import jax.numpy as jnp
+
+        from kvedge_tpu.models.decode import sample_token
+
+        greedy = np.asarray(jnp.argmax(logits, axis=-1))
+        samplers = {
+            slot: req for slot, req in self._active.items()
+            if req.sampling is not None
+        }
+        out = {
+            slot: int(greedy[slot])
+            for slot in self._active if slot not in samplers
+        }
+        if samplers:
+            slots = sorted(samplers)
+            seed_keys = jnp.stack(
+                [samplers[s].sampling[0] for s in slots]
+            )
+            # Each request's token index is its own len(generated)+1 —
+            # one vmapped fold_in keeps the per-request key schedule.
+            steps = jnp.asarray(
+                [len(samplers[s].generated) + 1 for s in slots], jnp.int32
+            )
+            keys = jax.vmap(jax.random.fold_in)(seed_keys, steps)
+            temps = jnp.asarray(
+                [samplers[s].sampling[1] for s in slots], jnp.float32
+            )[:, None]
+            top_ps = jnp.asarray(
+                [samplers[s].sampling[2] for s in slots], jnp.float32
+            )[:, None]
+            picked = np.asarray(sample_token(
+                logits[jnp.asarray(slots)], keys, temps, top_ps
+            ))
+            for i, s in enumerate(slots):
+                out[s] = int(picked[i])
+        return out
+
     def _loop(self) -> None:
         import jax.numpy as jnp
 
@@ -218,9 +288,10 @@ class PagedGenerationServer:
                     logits = self._cache.step(
                         self._params, jnp.asarray(tokens)
                     )
+                    next_tokens = self._next_tokens(logits)
                     for slot, req in self._active.items():
                         req.generated.append(req.next_token)
-                        req.next_token = int(jnp.argmax(logits[slot]))
+                        req.next_token = next_tokens[slot]
                 except Exception as e:  # poison: fail every waiter loudly
                     for req in self._active.values():
                         req.error = e

@@ -73,6 +73,11 @@ class RuntimeHandle:
     def shutdown(self) -> None:
         self.writer.stop()
         self.server.shutdown()
+        # The serve payload's backend may own a decode thread + device
+        # page pool (models/serving.py); release them with the runtime.
+        closer = getattr(self.serve_fn, "close", None)
+        if closer is not None:
+            closer()
 
 
 def _degraded(error: str) -> DeviceCheckResult:

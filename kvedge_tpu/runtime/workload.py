@@ -535,6 +535,7 @@ def run_serve_payload(cfg: RuntimeConfig):
     import threading
     import time as time_mod
 
+    import jax
     import jax.numpy as jnp
 
     from kvedge_tpu.models import generate
@@ -590,6 +591,34 @@ def run_serve_payload(cfg: RuntimeConfig):
                 # floats (1.9 -> 1) and decode a different prompt than
                 # the client sent.
                 raise ValueError("token rows must contain integers")
+            # Sampling controls: temperature 0 (default) = greedy; > 0
+            # samples through the shared nucleus filter with the
+            # deterministic per-row key schedule (seed, row, token) —
+            # identical across the contiguous and paged backends.
+            raw_t = doc.get("temperature", 0.0)
+            raw_p = doc.get("top_p", 1.0)
+            raw_seed = doc.get("seed", 0)
+            # Strict types, same discipline as the token check above:
+            # bool is an int subclass (true would silently become 1.0 and
+            # switch the client to sampling), and a float seed would
+            # silently truncate to a seed the client did not send.
+            if (not isinstance(raw_t, (int, float))
+                    or isinstance(raw_t, bool)
+                    or not isinstance(raw_p, (int, float))
+                    or isinstance(raw_p, bool)
+                    or not isinstance(raw_seed, int)
+                    or isinstance(raw_seed, bool)):
+                raise ValueError(
+                    "'temperature'/'top_p' must be numbers and 'seed' "
+                    "an integer"
+                )
+            temperature, top_p, seed = float(raw_t), float(raw_p), raw_seed
+            if temperature < 0.0:
+                raise ValueError("'temperature' must be >= 0")
+            if not 0.0 < top_p <= 1.0:
+                raise ValueError("'top_p' must be in (0, 1]")
+            sampled = temperature > 0.0
+            base_key = jax.random.PRNGKey(seed) if sampled else None
             if paged_server is not None:
                 # Continuous batching: each row is its own request into
                 # the shared page pool, submitted CONCURRENTLY so the
@@ -606,8 +635,16 @@ def run_serve_payload(cfg: RuntimeConfig):
 
                 def one_row(i, row):
                     try:
+                        row_sampling = None
+                        if sampled:
+                            row_sampling = (
+                                jax.random.fold_in(base_key, i),
+                                jnp.float32(temperature),
+                                jnp.float32(top_p),
+                            )
                         rows[i] = paged_server.submit(
-                            [t % tcfg.vocab for t in row], n_new
+                            [t % tcfg.vocab for t in row], n_new,
+                            sampling=row_sampling,
                         )
                     except Exception as e:
                         errors[i] = e
@@ -620,21 +657,35 @@ def run_serve_payload(cfg: RuntimeConfig):
                     w.start()
                 for w in workers:
                     w.join()
+                # Real faults outrank capacity conditions: a decode
+                # exception in one row must surface as the 500 it is,
+                # not hide behind another row's retryable 503.
+                for e in errors:
+                    if e is not None and not isinstance(
+                        e, (ServerBusy, ServerClosed)
+                    ):
+                        raise e
                 for e in errors:
                     if isinstance(e, (ServerBusy, ServerClosed)):
                         # Retryable capacity condition, not a server
                         # fault: surface as 503, not 500.
                         raise GenerateUnavailable(str(e)) from e
-                    if e is not None:
-                        raise e
                 return {
                     "tokens": rows,
                     "n_new": n_new,
                     "restored_step": restored_step,
                 }
             prompt = jnp.asarray(tokens, jnp.int32) % tcfg.vocab
+            sampling = None
+            if sampled:
+                seed_keys = jax.vmap(
+                    lambda i: jax.random.fold_in(base_key, i)
+                )(jnp.arange(len(tokens)))
+                sampling = (seed_keys, jnp.float32(temperature),
+                            jnp.float32(top_p))
             with lock:
-                out = generate(params, prompt, tcfg, n_new=n_new)
+                out = generate(params, prompt, tcfg, n_new=n_new,
+                               sampling=sampling, sampled=sampled)
             return {
                 "tokens": [[int(t) for t in row] for row in out.tolist()],
                 "n_new": n_new,
@@ -656,7 +707,17 @@ def run_serve_payload(cfg: RuntimeConfig):
         start = time_mod.perf_counter()
         probe = serve_fn({"tokens": [probe_prompt], "n_new": probe_new})
         elapsed_ms = (time_mod.perf_counter() - start) * 1000.0
+        # Teardown path: the paged server owns a decode thread and the
+        # device-side page pool; callers (RuntimeHandle.shutdown, test
+        # fixtures) release them via serve_fn.close().
+        serve_fn.close = (paged_server.close if paged_server is not None
+                          else lambda: None)
     except Exception as e:
+        if cfg.payload_serving == "paged":
+            try:
+                paged_server.close()
+            except (NameError, UnboundLocalError):
+                pass  # failed before the server existed
         return dataclasses.replace(
             base, ok=False, error=f"serve payload failed: {e!r}",
         ), None

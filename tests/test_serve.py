@@ -359,8 +359,12 @@ def test_paged_serving_matches_contiguous(tmp_path):
     )
     assert paged_check.ok, paged_check.error
 
-    req = {"tokens": [[5, 9, 2, 7], [1, 1, 4, 3]], "n_new": 5}
-    got = paged_fn(req)
-    want = contiguous_fn(req)
-    assert got["tokens"] == want["tokens"]
-    assert got["restored_step"] == want["restored_step"]
+    try:
+        req = {"tokens": [[5, 9, 2, 7], [1, 1, 4, 3]], "n_new": 5}
+        got = paged_fn(req)
+        want = contiguous_fn(req)
+        assert got["tokens"] == want["tokens"]
+        assert got["restored_step"] == want["restored_step"]
+    finally:
+        paged_fn.close()
+        contiguous_fn.close()
