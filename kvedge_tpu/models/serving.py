@@ -25,10 +25,14 @@ capability the repo's own README listed as future work.
 from __future__ import annotations
 
 import dataclasses
+import queue
 import threading
 import time
 
 import numpy as np
+
+# Stream sentinel objects (token queue carries ints, then one of these).
+_STREAM_DONE = object()
 
 
 class ServerBusy(RuntimeError):
@@ -53,6 +57,9 @@ class _Request:
         default_factory=threading.Event
     )
     error: Exception | None = None
+    # Set for streaming requests: every generated token is put here as it
+    # lands, then _STREAM_DONE (or the failing exception).
+    stream: "queue.SimpleQueue | None" = None
 
     def pick(self, logits_row, step: int) -> int:
         """Next token from a [V] logits row, greedy or sampled. Used at
@@ -120,6 +127,36 @@ class PagedGenerationServer:
         :class:`ServerBusy` when capacity doesn't free up within
         ``timeout``, ValueError for requests that can never fit.
         """
+        req = self._start(prompt, n_new, timeout, sampling, stream=False)
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        return req.prompt + req.generated
+
+    def submit_stream(self, prompt: list[int], n_new: int,
+                      timeout: float = 120.0,
+                      sampling: tuple | None = None):
+        """Streaming generate: yields each generated token as it lands.
+
+        Same admission/sampling semantics as :meth:`submit`; the request
+        decodes to completion even if the consumer stops iterating early
+        (its budget was reserved at admission — a disconnecting client
+        does not perturb co-tenants). A mid-stream failure raises from
+        the generator after the tokens already produced.
+        """
+        req = self._start(prompt, n_new, timeout, sampling, stream=True)
+        produced = 0
+        while produced < n_new:
+            item = req.stream.get()
+            if item is _STREAM_DONE:
+                break
+            if isinstance(item, Exception):
+                raise item
+            produced += 1
+            yield item
+
+    def _start(self, prompt: list[int], n_new: int, timeout: float,
+               sampling: tuple | None, stream: bool) -> _Request:
         if not prompt or n_new < 1:
             raise ValueError("need a non-empty prompt and n_new >= 1")
         total = len(prompt) + n_new
@@ -142,7 +179,10 @@ class PagedGenerationServer:
 
         import jax.numpy as jnp
 
-        req = _Request(prompt=list(prompt), n_new=n_new, sampling=sampling)
+        req = _Request(
+            prompt=list(prompt), n_new=n_new, sampling=sampling,
+            stream=queue.SimpleQueue() if stream else None,
+        )
         deadline = time.monotonic() + timeout
         with self._work:
             while (not self._closed
@@ -174,11 +214,7 @@ class PagedGenerationServer:
                 raise
             self._active[slot] = req
             self._work.notify_all()  # wake the decode loop
-
-        req.done.wait()
-        if req.error is not None:
-            raise req.error
-        return req.prompt + req.generated
+        return req
 
     def close(self) -> None:
         with self._work:
@@ -208,6 +244,13 @@ class PagedGenerationServer:
     def _pages_for(self, req: _Request) -> int:
         return -(-(len(req.prompt) + req.n_new) // self._cache.page_size)
 
+    @staticmethod
+    def _emit(req: _Request, token: int) -> None:
+        """Record a generated token (and stream it when requested)."""
+        req.generated.append(token)
+        if req.stream is not None:
+            req.stream.put(token)
+
     def _next_tokens(self, logits) -> dict[int, int]:
         """Every active slot's next token from the step's [slots, V]
         logits — ONE batched argmax plus (when any request samples) ONE
@@ -218,15 +261,18 @@ class PagedGenerationServer:
 
         from kvedge_tpu.models.decode import sample_token
 
-        greedy = np.asarray(jnp.argmax(logits, axis=-1))
         samplers = {
             slot: req for slot, req in self._active.items()
             if req.sampling is not None
         }
-        out = {
-            slot: int(greedy[slot])
-            for slot in self._active if slot not in samplers
-        }
+        out: dict[int, int] = {}
+        if len(samplers) < len(self._active):
+            # Greedy slots exist: one batched argmax + one host read.
+            greedy = np.asarray(jnp.argmax(logits, axis=-1))
+            out = {
+                slot: int(greedy[slot])
+                for slot in self._active if slot not in samplers
+            }
         if samplers:
             slots = sorted(samplers)
             seed_keys = jnp.stack(
@@ -262,6 +308,8 @@ class PagedGenerationServer:
                     for req in self._active.values():
                         req.error = ServerClosed("server shut down mid-"
                                                  "request")
+                        if req.stream is not None:
+                            req.stream.put(req.error)
                         req.done.set()
                     self._active.clear()
                     return
@@ -273,10 +321,12 @@ class PagedGenerationServer:
                     for slot in list(self._active):
                         req = self._active[slot]
                         if len(req.generated) + 1 >= req.n_new:
-                            req.generated.append(req.next_token)
+                            self._emit(req, req.next_token)
                             del self._active[slot]
                             self._release_locked(slot,
                                                  self._pages_for(req))
+                            if req.stream is not None:
+                                req.stream.put(_STREAM_DONE)
                             req.done.set()
                     if not self._active:
                         continue
@@ -290,11 +340,13 @@ class PagedGenerationServer:
                     )
                     next_tokens = self._next_tokens(logits)
                     for slot, req in self._active.items():
-                        req.generated.append(req.next_token)
+                        self._emit(req, req.next_token)
                         req.next_token = next_tokens[slot]
                 except Exception as e:  # poison: fail every waiter loudly
                     for req in self._active.values():
                         req.error = e
+                        if req.stream is not None:
+                            req.stream.put(e)
                         req.done.set()
                     self._active.clear()
                     self._closed = True

@@ -242,13 +242,45 @@ class StatusServer:
                     self._send(400, {"error": f"invalid JSON body: {e}"})
                     return
                 try:
-                    self._send(200, outer._generator(doc))
+                    result = outer._generator(doc)
                 except ValueError as e:  # malformed request semantics
                     self._send(400, {"error": str(e)})
+                    return
                 except GenerateUnavailable as e:
                     self._send(503, {"error": str(e)})
+                    return
                 except Exception as e:  # generation failed; stay serving
                     self._send(500, {"error": f"generate failed: {e!r}"})
+                    return
+                stream = (result or {}).get("_stream")
+                if stream is None:
+                    self._send(200, result)
+                    return
+                # Streaming: newline-delimited JSON, one document per
+                # token, end-of-body delimited by connection close
+                # (HTTP/1.0 semantics — no Content-Length, no chunked
+                # framing to desync on). Mid-stream failures can no
+                # longer change the status code; they surface as a final
+                # {"error": ...} line.
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()
+                self.close_connection = True
+                try:
+                    for item in stream:
+                        self.wfile.write(
+                            (json.dumps(item) + "\n").encode()
+                        )
+                        self.wfile.flush()
+                except BrokenPipeError:
+                    pass  # client went away; the request runs out server-side
+                except Exception as e:
+                    try:
+                        self.wfile.write(
+                            (json.dumps({"error": repr(e)}) + "\n").encode()
+                        )
+                    except OSError:
+                        pass
 
         self._snapshot = snapshot
         self._server = ThreadingHTTPServer((bind, port), Handler)

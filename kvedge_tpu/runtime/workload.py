@@ -613,6 +613,26 @@ def run_serve_payload(cfg: RuntimeConfig):
                     "an integer"
                 )
             temperature, top_p, seed = float(raw_t), float(raw_p), raw_seed
+
+            def row_sampling(i: int):
+                """Row i's sampling triple — ONE definition of the
+                cross-backend key schedule (fold_in(base, row))."""
+                if not sampled:
+                    return None
+                return (jax.random.fold_in(base_key, i),
+                        jnp.float32(temperature), jnp.float32(top_p))
+
+            stream = doc.get("stream", False)
+            if not isinstance(stream, bool):
+                raise ValueError("'stream' must be a boolean")
+            if stream and paged_server is None:
+                raise ValueError(
+                    "'stream' requires [payload] serving = \"paged\" — "
+                    "the contiguous backend decodes the whole request as "
+                    "one compiled program, so there is nothing to stream"
+                )
+            if stream and len(tokens) != 1:
+                raise ValueError("'stream' supports exactly one token row")
             if temperature < 0.0:
                 raise ValueError("'temperature' must be >= 0")
             if not 0.0 < top_p <= 1.0:
@@ -630,21 +650,43 @@ def run_serve_payload(cfg: RuntimeConfig):
                 )
                 from kvedge_tpu.runtime.status import GenerateUnavailable
 
+                if stream:
+                    row = [t % tcfg.vocab for t in tokens[0]]
+                    source = paged_server.submit_stream(
+                        row, n_new, sampling=row_sampling(0)
+                    )
+                    # Prime for the first token HERE, before the handler
+                    # commits a 200: admission failures (ServerBusy) must
+                    # surface as a clean 503 status, which is impossible
+                    # once streaming has started.
+                    try:
+                        first = next(source)
+                    except (ServerBusy, ServerClosed) as e:
+                        raise GenerateUnavailable(str(e)) from e
+
+                    def ndjson():
+                        generated = [first]
+                        yield {"token": first}
+                        for token in source:
+                            generated.append(token)
+                            yield {"token": token}
+                        yield {
+                            "done": True,
+                            "tokens": [row + generated],
+                            "n_new": n_new,
+                            "restored_step": restored_step,
+                        }
+
+                    return {"_stream": ndjson()}
+
                 rows: list = [None] * len(tokens)
                 errors: list = [None] * len(tokens)
 
                 def one_row(i, row):
                     try:
-                        row_sampling = None
-                        if sampled:
-                            row_sampling = (
-                                jax.random.fold_in(base_key, i),
-                                jnp.float32(temperature),
-                                jnp.float32(top_p),
-                            )
                         rows[i] = paged_server.submit(
                             [t % tcfg.vocab for t in row], n_new,
-                            sampling=row_sampling,
+                            sampling=row_sampling(i),
                         )
                     except Exception as e:
                         errors[i] = e
@@ -715,9 +757,10 @@ def run_serve_payload(cfg: RuntimeConfig):
     except Exception as e:
         if cfg.payload_serving == "paged":
             try:
-                paged_server.close()
+                if paged_server is not None:
+                    paged_server.close()
             except (NameError, UnboundLocalError):
-                pass  # failed before the server existed
+                pass  # failed before the variable existed
         return dataclasses.replace(
             base, ok=False, error=f"serve payload failed: {e!r}",
         ), None

@@ -85,7 +85,6 @@ def test_paged_server_sampling_matches_contiguous(params):
     n_new = 8
     temperature, top_p, seed = 0.8, 0.9, 11
 
-    padded = max(len(p) for p in prompts)
     # Contiguous backend needs uniform rows: run each row alone (batch 1)
     # so ragged prompts stay honest; per-row seed key = fold_in(base, i).
     base = jax.random.PRNGKey(seed)
@@ -112,7 +111,6 @@ def test_paged_server_sampling_matches_contiguous(params):
     finally:
         server.close()
     assert got == want
-    del padded
 
 
 def test_serve_endpoint_sampling_fields(tmp_path):

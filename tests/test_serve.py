@@ -368,3 +368,59 @@ def test_paged_serving_matches_contiguous(tmp_path):
     finally:
         paged_fn.close()
         contiguous_fn.close()
+
+
+def test_http_generate_streams_ndjson(tmp_path):
+    """End-to-end streaming: one JSON document per token over the wire,
+    final document carries the full result; tokens equal the
+    non-streamed greedy decode."""
+    handle = start_runtime(_cfg(
+        tmp_path, payload_serving="paged", status_token="serve-tok"
+    ))
+    try:
+        base = f"http://127.0.0.1:{handle.status_port}"
+        _, want = _post(f"{base}/generate",
+                        {"tokens": [[5, 9, 2]], "n_new": 4},
+                        token="serve-tok")
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=json.dumps({"tokens": [[5, 9, 2]], "n_new": 4,
+                             "stream": True}).encode(),
+            headers={"Authorization": "Bearer serve-tok"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/x-ndjson"
+            lines = [json.loads(ln) for ln in resp.read().splitlines()]
+        token_lines = [ln for ln in lines if "token" in ln]
+        (final,) = [ln for ln in lines if ln.get("done")]
+        assert len(token_lines) == 4
+        assert final["tokens"] == want["tokens"]
+        assert [ln["token"] for ln in token_lines] == want["tokens"][0][3:]
+    finally:
+        handle.shutdown()
+
+
+def test_http_generate_stream_rejected_on_contiguous_backend(tmp_path):
+    check, serve_fn = run_serve_payload(_cfg(tmp_path))
+    assert check.ok
+    try:
+        with pytest.raises(ValueError, match="paged"):
+            serve_fn({"tokens": [[1, 2]], "n_new": 4, "stream": True})
+        with pytest.raises(ValueError, match="boolean"):
+            serve_fn({"tokens": [[1, 2]], "n_new": 4, "stream": 1})
+    finally:
+        serve_fn.close()
+
+
+def test_stream_rejects_multiple_rows(tmp_path):
+    check, serve_fn = run_serve_payload(
+        _cfg(tmp_path, payload_serving="paged")
+    )
+    assert check.ok
+    try:
+        with pytest.raises(ValueError, match="one token row"):
+            serve_fn({"tokens": [[1, 2], [3, 4]], "n_new": 4,
+                      "stream": True})
+    finally:
+        serve_fn.close()

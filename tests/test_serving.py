@@ -164,3 +164,33 @@ def test_close_fails_pending_requests(params):
     t.join(timeout=60)
     # Either it finished before close landed, or it failed loudly.
     assert not errors or isinstance(errors[0], ServerClosed)
+
+
+def test_submit_stream_yields_same_tokens_incrementally(params):
+    server = PagedGenerationServer(params, CFG, slots=2, pages=16)
+    try:
+        prompt = [5, 9, 2, 7]
+        want = reference(params, prompt, 6)
+        got = list(server.submit_stream(prompt, n_new=6))
+        assert prompt + got == want
+        assert len(got) == 6
+    finally:
+        server.close()
+
+
+def test_submit_stream_concurrent_with_blocking_request(params):
+    server = PagedGenerationServer(params, CFG, slots=2, pages=24)
+    try:
+        blocking: list[list[int]] = []
+        t = threading.Thread(
+            target=lambda: blocking.append(
+                server.submit([3, 1, 4], n_new=10)
+            )
+        )
+        t.start()
+        streamed = list(server.submit_stream([2, 7, 7], n_new=8))
+        t.join(timeout=300)
+        assert [2, 7, 7] + streamed == reference(params, [2, 7, 7], 8)
+        assert blocking[0] == reference(params, [3, 1, 4], 10)
+    finally:
+        server.close()
