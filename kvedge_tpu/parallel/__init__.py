@@ -13,6 +13,7 @@ from kvedge_tpu.parallel.pipeline import pipeline_layers
 from kvedge_tpu.parallel.ringattention import ring_attention, sequence_sharding
 from kvedge_tpu.parallel.ulysses import ulysses_attention
 from kvedge_tpu.parallel.sharding import (
+    abstract_shard_tree,
     batch_spec,
     param_specs,
     shard_params,
@@ -21,6 +22,7 @@ from kvedge_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "abstract_shard_tree",
     "build_mesh",
     "local_mesh",
     "batch_spec",

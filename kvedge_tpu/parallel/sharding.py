@@ -100,6 +100,38 @@ def shard_batch(mesh, batch):
     return jax.device_put(batch, NamedSharding(mesh, batch_spec(mesh)))
 
 
+def _is_param_dict(sub) -> bool:
+    return (isinstance(sub, dict) and bool(sub)
+            and set(sub) <= set(PARAM_RULES))
+
+
+def abstract_shard_tree(mesh, tree):
+    """Attach placements to an abstract (``jax.eval_shape``) state tree.
+
+    Param-shaped dicts get the partition rules; every other leaf is
+    replicated over the mesh. This is how a checkpoint is restored
+    DIRECTLY into its mesh placement (orbax reads each shard's slice of
+    the array), instead of restoring onto one device and re-slicing —
+    the restore-side half of :func:`shard_tree`, for the ``eval`` and
+    ``serve`` payloads that restore a mesh-sharded training checkpoint.
+    """
+    def annotate(sub):
+        if _is_param_dict(sub):
+            specs = param_specs(sub, mesh)
+            return {
+                name: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype,
+                    sharding=NamedSharding(mesh, specs[name]),
+                )
+                for name, leaf in sub.items()
+            }
+        return jax.ShapeDtypeStruct(
+            sub.shape, sub.dtype, sharding=NamedSharding(mesh, P())
+        )
+
+    return jax.tree_util.tree_map(annotate, tree, is_leaf=_is_param_dict)
+
+
 def shard_tree(mesh, tree):
     """Shard a params dict OR any optimizer-state tree containing them.
 
@@ -110,8 +142,7 @@ def shard_tree(mesh, tree):
     ``prepare=`` callable for the resumable training driver.
     """
     def maybe_shard(sub):
-        if (isinstance(sub, dict) and sub
-                and set(sub) <= set(PARAM_RULES)):
+        if _is_param_dict(sub):
             return shard_params(mesh, sub)
         return sub
 

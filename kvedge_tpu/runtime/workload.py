@@ -390,7 +390,7 @@ def _global_batches(cfg: RuntimeConfig, tcfg, mesh, feeder, n_proc: int):
             yield shard_batch(mesh, batch % tcfg.vocab)
 
 
-def _restore_latest_params(cfg: RuntimeConfig, tcfg):
+def _restore_latest_params(cfg: RuntimeConfig, tcfg, mesh=None):
     """(step | None, params) from the latest checkpoint, or the fresh
     deterministic init when the volume has none.
 
@@ -399,10 +399,16 @@ def _restore_latest_params(cfg: RuntimeConfig, tcfg):
     state, seed 0) — that is the structure orbax wrote, and drift
     surfaces only as a tree-structure mismatch at restore time, so there
     is exactly one definition of it outside the trainer.
+
+    With ``mesh``, the restore is placement-aware: orbax restores each
+    param straight into its ``NamedSharding`` (the same rules training
+    sharded it with), so a tp/ep-sharded checkpoint lands distributed —
+    never materialized on one device first.
     """
     import jax
 
     from kvedge_tpu.models import init_params, make_train_step
+    from kvedge_tpu.parallel import abstract_shard_tree, shard_params
     from kvedge_tpu.runtime.checkpoint import StateCheckpointer
 
     init_opt, _ = make_train_step(tcfg)
@@ -411,16 +417,20 @@ def _restore_latest_params(cfg: RuntimeConfig, tcfg):
         p = init_params(jax.random.PRNGKey(0), tcfg)
         return {"params": p, "opt_state": init_opt(p)}
 
+    abstract = jax.eval_shape(fresh_state)
+    if mesh is not None:
+        abstract = abstract_shard_tree(mesh, abstract)
     with StateCheckpointer(
         cfg.state_dir, checkpoint_dir=cfg.checkpoint_dir
     ) as ckpt:
-        restored = ckpt.restore_latest(jax.eval_shape(fresh_state))
+        restored = ckpt.restore_latest(abstract)
     if restored is not None:
         step, tree = restored
         return step, tree["params"]
     # fresh_state stays abstract — materializing it would allocate the
     # optimizer moments only to discard them.
-    return None, init_params(jax.random.PRNGKey(0), tcfg)
+    params = init_params(jax.random.PRNGKey(0), tcfg)
+    return None, params if mesh is None else shard_params(mesh, params)
 
 
 def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
@@ -446,11 +456,9 @@ def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     import math
 
     import jax
-    import numpy as np
 
     from kvedge_tpu.data import open_feeder
     from kvedge_tpu.models import loss_fn
-    from kvedge_tpu.parallel import shard_batch, shard_params
 
     error, geometry = _feed_geometry(cfg, base, "eval")
     if error is not None:
@@ -460,8 +468,7 @@ def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     feeder = None
     try:
         tcfg, mesh = train_model_config(cfg)
-        step, params = _restore_latest_params(cfg, tcfg)
-        params = shard_params(mesh, params)
+        step, params = _restore_latest_params(cfg, tcfg, mesh=mesh)
 
         # Pure next-token cross-entropy: zeroing the aux weight drops the
         # MoE router's load-balancing term from the reported number —
@@ -516,6 +523,14 @@ def run_serve_payload(cfg: RuntimeConfig):
     serves the same deterministic init training would start from, so the
     endpoint works before any training has happened.
 
+    Mesh-aware: params restore straight into the configured mesh's
+    placements (the same partition rules training used), and decode runs
+    under jit with those shardings driving XLA's SPMD partitioner — a
+    checkpoint that needed the ``model``/``expert`` axes to train serves
+    over them too. Multi-host serve is refused with a clear
+    :class:`MeshConfigError` (each process would independently restore
+    and serve).
+
     Returns ``(DeviceCheckResult, serve_fn | None)``; ``serve_fn(doc)``
     implements the request contract::
 
@@ -541,8 +556,26 @@ def run_serve_payload(cfg: RuntimeConfig):
     from kvedge_tpu.models import generate
 
     try:
-        tcfg, _ = train_model_config(cfg)
-        restored_step, params = _restore_latest_params(cfg, tcfg)
+        tcfg, mesh = train_model_config(cfg)
+        if jax.process_count() > 1:
+            # Single-host only, refused loudly: every process of a slice
+            # would independently restore the checkpoint and answer
+            # /generate through its own pod IP — N divergent serving
+            # replicas pretending to be one endpoint. (Training is the
+            # multi-host payload; serving a slice needs a request router
+            # that does not exist yet.)
+            raise MeshConfigError(
+                "multi-host serve is not supported: "
+                f"{jax.process_count()} processes would each restore and "
+                "serve independently; deploy serve as a single-host "
+                "release ([distributed] num_processes = 1)"
+            )
+        # Placement-aware restore: params land sharded over THIS mesh
+        # (model/expert/stage axes), so a checkpoint whose model needed
+        # tensor parallelism to fit serves over the same axes — decode
+        # runs under jit with the input shardings driving XLA's SPMD
+        # partitioner, exactly like the train step.
+        restored_step, params = _restore_latest_params(cfg, tcfg, mesh=mesh)
 
         paged_server = None
         if cfg.payload_serving == "paged":
@@ -754,6 +787,10 @@ def run_serve_payload(cfg: RuntimeConfig):
         # fixtures) release them via serve_fn.close().
         serve_fn.close = (paged_server.close if paged_server is not None
                           else lambda: None)
+    except MeshConfigError as e:
+        # Raised before any server/device state exists: surface the
+        # operator-facing config message, not a wrapped traceback.
+        return dataclasses.replace(base, ok=False, error=str(e)), None
     except Exception as e:
         if cfg.payload_serving == "paged":
             try:

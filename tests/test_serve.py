@@ -154,6 +154,74 @@ def test_train_then_serve_restores_trained_params(tmp_path):
                                   np.asarray(so_far))
 
 
+def test_tp_mesh_checkpoint_serves_sharded(tmp_path):
+    """VERDICT r2 #1 done-bar: a {data:2, model:4}-trained checkpoint
+    serves through the mesh-aware path with tokens IDENTICAL to the
+    unsharded single-device decode of the same params — and the served
+    params really are sharded over the model axis (not replicated)."""
+    import jax
+    import numpy as np
+
+    from kvedge_tpu.config.runtime_config import MeshSpec
+    from kvedge_tpu.data import write_corpus
+    from kvedge_tpu.models import generate
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+    from kvedge_tpu.runtime.workload import _restore_latest_params
+
+    corpus = tmp_path / "corpus.kvfeed"
+    rng = np.random.default_rng(23)
+    write_corpus(corpus, rng.integers(0, 512, size=3000, dtype=np.int32))
+    mesh_spec = MeshSpec(axes=(("data", 2), ("model", 4)))
+
+    result = run_train_payload(_cfg(
+        tmp_path, payload="train", train_corpus=str(corpus),
+        train_steps=3, train_batch=8, train_checkpoint_every=3,
+        mesh=mesh_spec,
+    ))
+    assert result.ok, result.error
+
+    serve_cfg = _cfg(tmp_path, mesh=mesh_spec)
+    tcfg, mesh = train_model_config(serve_cfg)
+    check, serve_fn = run_serve_payload(serve_cfg)
+    assert check.ok, check.error
+    try:
+        out = serve_fn({"tokens": [[3, 1, 4], [2, 7, 2]], "n_new": 4})
+        assert out["restored_step"] == 3
+
+        # The restore is genuinely placement-aware: qkv shards its output
+        # features over the 4-way model axis.
+        _, sharded = _restore_latest_params(serve_cfg, tcfg, mesh=mesh)
+        spec = sharded["w_qkv"].sharding.spec
+        assert "model" in jax.tree_util.tree_leaves(list(spec))
+
+        # Unsharded single-device decode of the SAME checkpoint must
+        # produce identical tokens.
+        with StateCheckpointer(serve_cfg.state_dir) as ckpt:
+            _, tree = ckpt.restore_latest()
+        import jax.numpy as jnp
+
+        want = generate(
+            tree["params"],
+            jnp.asarray([[3, 1, 4], [2, 7, 2]], jnp.int32), tcfg, n_new=4,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["tokens"]), np.asarray(want)
+        )
+    finally:
+        serve_fn.close()
+
+
+def test_serve_refuses_multihost(tmp_path, monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    check, serve_fn = run_serve_payload(_cfg(tmp_path))
+    assert serve_fn is None
+    assert not check.ok
+    assert "multi-host serve" in check.error
+    assert "num_processes" in check.error
+
+
 # ---- HTTP surface --------------------------------------------------------
 
 
