@@ -167,6 +167,16 @@ class RuntimeConfig:
     # (models/serving.py): concurrent requests share one page pool and
     # one batched decode step.
     payload_serving: str = ""
+    # Paged-backend pool sizing ([payload] serving_*): how many requests
+    # decode concurrently (slots), the KV page granule (page_size), and
+    # the total page pool. pages = 0 auto-sizes the pool so every slot
+    # can hold a worst-case (max_seq) request — admission then only ever
+    # waits on slots. Operators trading memory for queueing can set
+    # pages lower; requests that can never fit are rejected up front
+    # (models/serving.py admission rules).
+    serving_slots: int = 4
+    serving_page_size: int = 16
+    serving_pages: int = 0
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -241,6 +251,16 @@ class RuntimeConfig:
                 payload_serving=str(
                     payload_doc.get("serving", cls.payload_serving)
                 ),
+                serving_slots=int(
+                    payload_doc.get("serving_slots", cls.serving_slots)
+                ),
+                serving_page_size=int(
+                    payload_doc.get("serving_page_size",
+                                    cls.serving_page_size)
+                ),
+                serving_pages=int(
+                    payload_doc.get("serving_pages", cls.serving_pages)
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -283,6 +303,17 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving must be '', 'contiguous', or 'paged', "
                 f"got {self.payload_serving!r}"
+            )
+        if self.serving_slots < 1:
+            raise RuntimeConfigError("[payload] serving_slots must be >= 1")
+        if self.serving_page_size < 1:
+            raise RuntimeConfigError(
+                "[payload] serving_page_size must be >= 1"
+            )
+        if self.serving_pages < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_pages must be >= 0 (0 = auto-size so "
+                "every slot fits a worst-case request)"
             )
         if self.payload in ("train", "eval") and not self.train_corpus:
             raise RuntimeConfigError(
@@ -333,6 +364,9 @@ class RuntimeConfig:
             f"kind = {s(self.payload)}\n"
             f"attention = {s(self.payload_attention)}\n"
             f"serving = {s(self.payload_serving)}\n"
+            f"serving_slots = {self.serving_slots}\n"
+            f"serving_page_size = {self.serving_page_size}\n"
+            f"serving_pages = {self.serving_pages}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"steps = {self.train_steps}\n"
             f"batch = {self.train_batch}\n"

@@ -68,6 +68,13 @@ class RuntimeHandle:
             "train_progress": heartbeat.read_train_progress(
                 self.cfg.state_dir
             ),
+            # Serving request/pool stats; None unless the serve payload
+            # is live (runtime/workload.py attaches .stats to serve_fn).
+            "serving": (
+                self.serve_fn.stats()
+                if getattr(self.serve_fn, "stats", None) is not None
+                else None
+            ),
         }
 
     def shutdown(self) -> None:

@@ -71,20 +71,27 @@ class StateCheckpointer:
     def latest_step(self) -> int | None:
         return self._manager.latest_step()
 
-    def restore_latest(self, abstract_tree: Any = None) -> tuple[int, Any] | None:
+    def restore_latest(self, abstract_tree: Any = None, *,
+                       partial: bool = False) -> tuple[int, Any] | None:
         """(step, tree) of the newest checkpoint, or None on a fresh volume.
 
         ``abstract_tree`` (e.g. ``jax.eval_shape`` output or a concrete
         template) restores with the correct dtypes/shardings; omitting it
-        falls back to orbax's topology inference.
+        falls back to orbax's topology inference. With ``partial=True``,
+        subtrees of ``abstract_tree`` replaced by ``orbax.checkpoint
+        .PLACEHOLDER`` are skipped entirely — never read, never allocated
+        (how ``serve``/``eval`` restore params without materializing the
+        optimizer moments). Partial restore is only valid on a manager
+        that has not saved in this process (orbax binds the handler to
+        the first args type it sees).
         """
         step = self._manager.latest_step()
         if step is None:
             return None
         if abstract_tree is not None:
-            tree = self._manager.restore(
-                step, args=self._ocp.args.StandardRestore(abstract_tree)
-            )
+            args = (self._ocp.args.PyTreeRestore(abstract_tree) if partial
+                    else self._ocp.args.StandardRestore(abstract_tree))
+            tree = self._manager.restore(step, args=args)
         else:
             tree = self._manager.restore(step)
         return step, tree

@@ -52,6 +52,41 @@ _METRIC_FIELDS = (
     ("heartbeat_age_s", "heartbeat_age_seconds", "age of the last heartbeat"),
 )
 
+# Serving observability (the ``serving`` sub-document of /status, fed by
+# the serve payload's request accounting + the paged server's pool
+# stats). Counter buckets mirror the HTTP classes POST /generate answers
+# with: rejected = 400, unavailable = 503, errors = 500.
+_SERVE_METRIC_FIELDS = (
+    # (serving key, metric suffix, TYPE, help text)
+    ("requests_total", "serve_requests_total", "counter",
+     "generate requests reaching the serving backend (transport-level "
+     "400s — bad framing/JSON — are rejected before it)"),
+    ("completed_total", "serve_completed_total", "counter",
+     "generate requests completed"),
+    ("rejected_total", "serve_rejected_total", "counter",
+     "invalid generate requests (HTTP 400)"),
+    ("unavailable_total", "serve_unavailable_total", "counter",
+     "capacity-refused generate requests (HTTP 503)"),
+    ("errors_total", "serve_errors_total", "counter",
+     "failed generate requests (HTTP 500)"),
+    ("tokens_generated_total", "serve_tokens_generated_total", "counter",
+     "tokens generated for clients"),
+    ("last_latency_ms", "serve_last_latency_ms", "gauge",
+     "latency of the most recently completed request"),
+    ("latency_ms_sum", "serve_latency_ms_sum", "counter",
+     "summed latency of completed requests (divide by "
+     "kvedge_serve_completed_total for the mean)"),
+    # Paged backend only: live pool occupancy.
+    ("in_flight", "serve_in_flight", "gauge",
+     "requests currently decoding (paged backend)"),
+    ("free_slots", "serve_free_slots", "gauge",
+     "free decode slots (paged backend)"),
+    ("free_pages", "serve_free_pages", "gauge",
+     "unreferenced KV pages in the pool (paged backend)"),
+    ("reserved_pages", "serve_reserved_pages", "gauge",
+     "worst-case pages reserved by in-flight requests (paged backend)"),
+)
+
 
 def render_metrics(snapshot: dict) -> str:
     """Render a /status snapshot as Prometheus text exposition format."""
@@ -96,6 +131,15 @@ def render_metrics(snapshot: dict) -> str:
                      "last training-progress write")
         lines.append("# TYPE kvedge_train_progress_ts gauge")
         lines.append(f"kvedge_train_progress_ts {progress['ts']}")
+    serving = snapshot.get("serving") or {}
+    for key, suffix, mtype, help_text in _SERVE_METRIC_FIELDS:
+        value = serving.get(key)
+        if value is None:
+            continue
+        name = f"kvedge_{suffix}"
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name} {value}")
     return "\n".join(lines) + "\n"
 
 

@@ -403,9 +403,13 @@ def _restore_latest_params(cfg: RuntimeConfig, tcfg, mesh=None):
     With ``mesh``, the restore is placement-aware: orbax restores each
     param straight into its ``NamedSharding`` (the same rules training
     sharded it with), so a tp/ep-sharded checkpoint lands distributed —
-    never materialized on one device first.
+    never materialized on one device first. Either way the optimizer
+    moments are PLACEHOLDER-skipped, not restored-then-discarded: a
+    serve pod sized for params + KV pool must not pay 3x params memory
+    for Adam state it will never read.
     """
     import jax
+    import orbax.checkpoint as ocp
 
     from kvedge_tpu.models import init_params, make_train_step
     from kvedge_tpu.parallel import abstract_shard_tree, shard_params
@@ -420,10 +424,13 @@ def _restore_latest_params(cfg: RuntimeConfig, tcfg, mesh=None):
     abstract = jax.eval_shape(fresh_state)
     if mesh is not None:
         abstract = abstract_shard_tree(mesh, abstract)
+    abstract["opt_state"] = jax.tree_util.tree_map(
+        lambda _: ocp.PLACEHOLDER, abstract["opt_state"]
+    )
     with StateCheckpointer(
         cfg.state_dir, checkpoint_dir=cfg.checkpoint_dir
     ) as ckpt:
-        restored = ckpt.restore_latest(abstract)
+        restored = ckpt.restore_latest(abstract, partial=True)
     if restored is not None:
         step, tree = restored
         return step, tree["params"]
@@ -581,19 +588,21 @@ def run_serve_payload(cfg: RuntimeConfig):
         if cfg.payload_serving == "paged":
             from kvedge_tpu.models.serving import PagedGenerationServer
 
-            # Pool sized so every slot can hold a worst-case request —
+            # Pool sized from the [payload] serving_* knobs; pages = 0
+            # auto-sizes so every slot can hold a worst-case request —
             # admission then only ever waits on slots, never on pages.
             # page_size passed explicitly so the sizing arithmetic and
             # the cache's pages can never drift apart.
-            slots, page_size = 4, 16
-            pages = slots * -(-tcfg.max_seq // page_size)
+            slots, page_size = cfg.serving_slots, cfg.serving_page_size
+            pages = (cfg.serving_pages
+                     or slots * -(-tcfg.max_seq // page_size))
             paged_server = PagedGenerationServer(
                 params, tcfg, slots=slots, pages=pages,
                 page_size=page_size,
             )
         lock = threading.Lock()
 
-        def serve_fn(doc: dict) -> dict:
+        def _serve(doc: dict) -> dict:
             tokens = doc.get("tokens")
             if (not isinstance(tokens, list) or not tokens
                     or not all(isinstance(r, list) and r for r in tokens)):
@@ -767,6 +776,93 @@ def run_serve_payload(cfg: RuntimeConfig):
                 "restored_step": restored_step,
             }
 
+        # Request accounting around _serve: the serving half of the
+        # observability story (/metrics kvedge_serve_* gauges). Counter
+        # buckets mirror the HTTP status classes the handler maps these
+        # exceptions to: rejected = 400, unavailable = 503, errors = 500.
+        from kvedge_tpu.runtime.status import GenerateUnavailable
+
+        stats_lock = threading.Lock()
+        counters = {
+            "requests_total": 0,
+            "completed_total": 0,
+            "rejected_total": 0,
+            "unavailable_total": 0,
+            "errors_total": 0,
+            "tokens_generated_total": 0,
+            "last_latency_ms": 0.0,
+            "latency_ms_sum": 0.0,
+        }
+
+        def _count(key: str, n: int = 1) -> None:
+            with stats_lock:
+                counters[key] += n
+
+        def _finish(start: float) -> None:
+            ms = (time_mod.perf_counter() - start) * 1000.0
+            with stats_lock:
+                counters["completed_total"] += 1
+                counters["last_latency_ms"] = ms
+                counters["latency_ms_sum"] += ms
+
+        def serve_fn(doc: dict) -> dict:
+            _count("requests_total")
+            start = time_mod.perf_counter()
+            try:
+                result = _serve(doc)
+            except ValueError:
+                _count("rejected_total")
+                raise
+            except GenerateUnavailable:
+                _count("unavailable_total")
+                raise
+            except Exception:
+                _count("errors_total")
+                raise
+            stream = result.get("_stream")
+            if stream is None:
+                _count("tokens_generated_total",
+                       result["n_new"] * len(result["tokens"]))
+                _finish(start)
+                return result
+
+            def counted():
+                # Latency for a streamed request = admission to final
+                # document; tokens count as they actually go out. A
+                # consumer abandoning the iterator mid-stream therefore
+                # never records a completion — matching what the client
+                # observed. A mid-decode FAILURE is not abandonment: it
+                # lands in the same outcome buckets as non-streamed
+                # requests (the HTTP status is already committed, but
+                # the operator's error counters must still see it).
+                try:
+                    for item in stream:
+                        if "token" in item:
+                            _count("tokens_generated_total")
+                        yield item
+                except GenerateUnavailable:
+                    _count("unavailable_total")
+                    raise
+                except Exception:
+                    _count("errors_total")
+                    raise
+                _finish(start)
+
+            return {**result, "_stream": counted()}
+
+        def serve_stats() -> dict:
+            with stats_lock:
+                out = dict(counters)
+            out["backend"] = ("paged" if paged_server is not None
+                              else "contiguous")
+            if paged_server is not None:
+                # Pool occupancy straight from the server (in_flight,
+                # free_slots, free_pages, reserved_pages).
+                out.update(paged_server.stats())
+            return out
+
+        serve_fn.stats = serve_stats
+
         # Self-check: one tiny generation proves the restored params and
         # the decode path actually work before the endpoint goes live.
         # Sized from the model so a small (legal) train_seq cannot fail a
@@ -780,7 +876,9 @@ def run_serve_payload(cfg: RuntimeConfig):
         probe_prompt = list(range(1, min(4, tcfg.max_seq - 1) + 1))
         probe_new = min(2, tcfg.max_seq - len(probe_prompt))
         start = time_mod.perf_counter()
-        probe = serve_fn({"tokens": [probe_prompt], "n_new": probe_new})
+        # Through _serve, not the counted wrapper: the boot self-check is
+        # not operator traffic, so the kvedge_serve_* counters start at 0.
+        probe = _serve({"tokens": [probe_prompt], "n_new": probe_new})
         elapsed_ms = (time_mod.perf_counter() - start) * 1000.0
         # Teardown path: the paged server owns a decode thread and the
         # device-side page pool; callers (RuntimeHandle.shutdown, test

@@ -65,6 +65,24 @@ def test_payload_attention_round_trips():
     assert RuntimeConfig.parse("").payload_attention == ""  # auto
 
 
+def test_serving_pool_knobs_round_trip_and_validate():
+    cfg = RuntimeConfig.parse(
+        "[payload]\nserving = 'paged'\nserving_slots = 8\n"
+        "serving_page_size = 32\nserving_pages = 96\n"
+    )
+    assert (cfg.serving_slots, cfg.serving_page_size, cfg.serving_pages) \
+        == (8, 32, 96)
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    # Defaults: 4 slots, 16-token pages, auto-sized pool.
+    default = RuntimeConfig.parse("")
+    assert (default.serving_slots, default.serving_page_size,
+            default.serving_pages) == (4, 16, 0)
+    for bad in ("serving_slots = 0", "serving_page_size = 0",
+                "serving_pages = -1"):
+        with pytest.raises(RuntimeConfigError):
+            RuntimeConfig.parse(f"[payload]\n{bad}\n")
+
+
 def test_mesh_resolution():
     spec = MeshSpec(axes=(("data", 0), ("model", 4)))
     assert spec.resolved_shape(8) == (2, 4)
