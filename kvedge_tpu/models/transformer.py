@@ -159,12 +159,15 @@ class TransformerConfig:
                     f"n_layers {self.n_layers} must divide by "
                     f"pipeline_stages {self.pipeline_stages}"
                 )
-            if self.attention in ("ring", "ulysses"):
+            if self.attention == "ulysses":
+                # Ring composes (the seq axis joins the pipeline's
+                # manual axes and the per-device fold runs directly);
+                # ulysses does not yet — its all_to_all re-shard assumes
+                # it owns the whole [B, T, H] layout, which the
+                # stage-sharded microbatch schedule breaks up.
                 raise ValueError(
-                    "pipeline parallelism does not compose with "
-                    "sequence-parallel attention yet (ring/ulysses run "
-                    "their own shard_map, which cannot nest inside the "
-                    "pipeline's)"
+                    "pipeline parallelism does not compose with ulysses "
+                    "attention; use attention='ring' for pp x sp"
                 )
 
 
@@ -283,7 +286,7 @@ def split_qkv(cfg: TransformerConfig, qkv):
 
 
 def _layer(cfg: TransformerConfig, x, layer_params, mesh=None,
-           constrain_moe: bool = True):
+           constrain_moe: bool = True, seq_manual=None):
     """One pre-norm decoder block. x: [B, T, D] in compute dtype.
 
     Returns ``(x, aux)`` — ``aux`` is the MoE router's load-balancing
@@ -293,6 +296,14 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None,
     expressed (manual axes are rejected), and expert placement instead
     rides the expert weights' own sharding through the dispatch/combine
     einsums.
+
+    ``seq_manual = (axis_name, sp)`` means this body is ALREADY inside a
+    shard_map whose manual axes include the sequence axis (the pp x sp
+    composition, parallel/pipeline.py): ``x`` is a local ``T/sp`` chunk,
+    rotary positions offset by the device's chunk index, and ring
+    attention calls its per-device body directly — the axis collectives
+    (ppermute) resolve against the enclosing manual context instead of
+    opening a nested shard_map.
     """
     if cfg.n_experts:
         w_qkv, w_out, router, w_up, w_down, ln_attn, ln_mlp = layer_params
@@ -307,6 +318,10 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None,
     qkv = normed @ w_qkv.astype(dtype)  # [B, T, (H+2K)*Dh]
     q, k, v = split_qkv(cfg, qkv)
     positions = jnp.arange(seq)
+    if seq_manual is not None:
+        # seq here is the LOCAL chunk length; chunks are contiguous in
+        # sequence order, so global positions offset by the ring index.
+        positions = lax.axis_index(seq_manual[0]) * seq + positions
     q = _rotary(q, positions)
     k = _rotary(k, positions)
     if kv != h:
@@ -315,7 +330,14 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None,
         # K/V is materialized in HBM.
         k = jnp.repeat(k, h // kv, axis=2)
         v = jnp.repeat(v, h // kv, axis=2)
-    if cfg.attention in ("ring", "ulysses"):
+    if seq_manual is not None and cfg.attention == "ring":
+        from kvedge_tpu.parallel.ringattention import _ring_attention_local
+
+        attended = _ring_attention_local(
+            q, k, v, axis_name=seq_manual[0], sp=seq_manual[1]
+        )
+        attended = attended.reshape(batch, seq, h * dh)
+    elif cfg.attention in ("ring", "ulysses"):
         if mesh is None:
             raise ValueError(
                 f"attention={cfg.attention!r} needs a mesh with a 'seq' "
@@ -421,12 +443,18 @@ def forward_hidden(params: dict, tokens, cfg: TransformerConfig,
         # pipeline's shard_map; constrain_moe=False because an activation
         # NamedSharding cannot be expressed in that partial-manual
         # context — expert placement propagates from the stacked expert
-        # weights' own sharding instead.
+        # weights' own sharding instead. A ``seq`` axis (ring attention)
+        # joins the pipeline's manual axes: the layer body runs seq-local
+        # and calls the ring's per-device fold directly (pp x sp).
+        sp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 0)
+        seq_manual = ("seq", sp) if cfg.attention == "ring" and sp else None
         x, aux = pipeline_layers(
             x, stacked,
             lambda carry, lp: _layer(cfg, carry, lp, mesh,
-                                     constrain_moe=False),
+                                     constrain_moe=False,
+                                     seq_manual=seq_manual),
             mesh, n_layers=cfg.n_layers,
+            seq_axis="seq" if seq_manual else None,
             n_microbatches=cfg.pipeline_microbatches, remat=cfg.remat,
             remat_policy=_remat_policy(cfg),
         )

@@ -22,8 +22,17 @@ TPU-first design:
   ``M / (M + S - 1)``, so more microbatches amortize it.
 * **Differentiable end-to-end**: ppermute's transpose is the reverse
   permutation and the final psum's is a broadcast, so ``jax.grad``
-  through the whole schedule yields the 1F1B-equivalent backward without
-  hand-written stage logic.
+  through the whole schedule yields a correct backward without
+  hand-written stage logic. This is GPipe, NOT 1F1B: the backward only
+  starts after all M forwards, so without remat the live activations
+  would grow with M (1F1B's defining property — <= S microbatches in
+  flight — does not hold). The schedule instead bounds memory with
+  ``remat=True`` (default): each microbatch x stage body checkpoints,
+  so the backward recomputes activations and the forward keeps only
+  layer inputs — peak live activations stay O(M x mb x T x D) carry
+  state, flat in depth. The bubble is GPipe's ``(S-1)/(M+S-1)`` in both
+  passes either way. tests/test_pipeline.py pins the memory claim with
+  a compiled-HLO peak-memory comparison at M=S vs M=2S.
 
 Composes with ``data`` parallelism (microbatches shard their batch dim on
 ``data``), with ``model`` tensor parallelism, and with ``expert`` MoE
@@ -36,8 +45,10 @@ per microbatch (ceil(k*mb_tokens*factor/E) slots per microbatch rather
 than one batch-wide pool), and the router's load-balancing statistics
 are computed per microbatch and averaged — fill/drain steps, which
 compute on garbage, are masked out of that average (see ``step_fn``).
-Sequence-parallel attention is still rejected — ring/ulysses run their
-own shard_map, which cannot nest inside this one.
+Ring attention composes too (``seq_axis``): the seq axis joins the
+manual set and the layer body calls the ring's per-device fold directly
+— see :func:`pipeline_layers`. Ulysses is still rejected (its
+all_to_all re-shard assumes it owns the whole layout).
 """
 
 from __future__ import annotations
@@ -48,14 +59,16 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-def _stage_specs(n_arrays: int, data_axis: str | None):
+def _stage_specs(n_arrays: int, data_axis: str | None,
+                 seq_axis: str | None):
     """in_specs: activations [M, mb, T, D] + n stacked params [L, ...]."""
-    act = P(None, data_axis, None, None)
+    act = P(None, data_axis, seq_axis, None)
     return (act, *([P("stage")] * n_arrays))
 
 
 def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
                     stage_axis: str = "stage", data_axis: str = "data",
+                    seq_axis: str | None = None,
                     n_microbatches: int = 0, remat: bool = True,
                     remat_policy=None):
     """Run ``n_layers`` stacked layers over ``x``, pipelined over stages.
@@ -67,6 +80,15 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
     load-balancing term; 0.0 for dense layers). Returns ``(out [B, T, D],
     aux scalar fp32)`` — ``aux`` is the mean over real (non-bubble)
     microbatch×layer evaluations, replicated across the mesh.
+
+    With ``seq_axis``, the activations' T dim additionally shards over
+    that axis and the axis joins the manual set — this is how pp×sp
+    composes: ring attention cannot NEST a shard_map inside this one,
+    but its per-device body only needs ``lax.axis_index(seq_axis)``, so
+    the layer body calls ``_ring_attention_local`` directly and the
+    ppermute stage hand-offs move ``1/sp`` of the tokens per hop. The
+    caller's ``layer_fn`` must already be seq-local (global positions
+    from the axis index; see models/transformer.py ``_layer``).
     """
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if stage_axis not in axis_sizes:
@@ -86,9 +108,12 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
         # XLA's CPU layout-assignment pass crashes the process ("Invalid
         # binary instruction opcode copy") on bf16 contractions against
         # auto-partitioned operands inside shard_map — a backend compiler
-        # bug (observed on jax 0.9.0 / CPU only; the TPU backend compiles
-        # this fine; hits both the Megatron model axis and the MoE expert
-        # axis). A loud error beats a segfault in test environments.
+        # bug (observed on jax 0.9.0 / CPU only; hits both the Megatron
+        # model axis and the MoE expert axis). Whether the TPU backend
+        # compiles the bf16 combination is UNVERIFIED: a multi-chip
+        # stage x model mesh cannot exist on this build's single chip,
+        # so pp x tp/ep is proven in fp32 (CPU mesh) and bf16 remains an
+        # untested claim. A loud error beats a segfault either way.
         raise ValueError(
             "bf16 pipeline x auto-partitioned model/expert axes trip an "
             "XLA CPU-backend compiler crash; use float32 compute "
@@ -108,6 +133,17 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
             f"microbatches) must divide by the {data_axis!r} axis size "
             f"{axis_sizes[data_axis]}"
         )
+    if seq_axis is not None:
+        if seq_axis not in axis_sizes:
+            raise ValueError(
+                f"mesh has no {seq_axis!r} axis (axes: "
+                f"{sorted(axis_sizes)}) — pp x sp needs one"
+            )
+        if x.shape[1] % axis_sizes[seq_axis]:
+            raise ValueError(
+                f"sequence length {x.shape[1]} must divide by the "
+                f"{seq_axis!r} axis size {axis_sizes[seq_axis]}"
+            )
 
     x_mb = x.reshape(micro, batch // micro, *x.shape[1:])  # [M, mb, T, D]
 
@@ -175,21 +211,26 @@ def pipeline_layers(x, stacked, layer_fn, mesh, *, n_layers: int,
         aux = lax.psum(aux_acc, stage_axis) / (micro * stages)
         if dspec:
             aux = lax.pmean(aux, data_axis)
+        if seq_axis is not None:
+            # Each seq shard's aux came from its own token chunk.
+            aux = lax.pmean(aux, seq_axis)
         return lax.psum(outputs, stage_axis), aux
 
-    # Only the stage (and data) axes go manual; any other mesh axis —
-    # notably a Megatron ``model`` axis on the stacked params' feature
-    # dims — stays *automatic*: XLA keeps partitioning those dims and
-    # inserting the tensor-parallel collectives inside each stage body,
-    # so pp composes with tp without the specs having to name it.
+    # Only the stage (and data, and — for pp x sp — seq) axes go manual;
+    # any other mesh axis — notably a Megatron ``model`` axis on the
+    # stacked params' feature dims — stays *automatic*: XLA keeps
+    # partitioning those dims and inserting the tensor-parallel
+    # collectives inside each stage body, so pp composes with tp without
+    # the specs having to name it.
     manual = frozenset(
         {stage_axis} | ({data_axis} if dspec else set())
+        | ({seq_axis} if seq_axis is not None else set())
     )
     out, aux = jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=_stage_specs(len(stacked), dspec),
-        out_specs=(P(None, dspec, None, None), P()),
+        in_specs=_stage_specs(len(stacked), dspec, seq_axis),
+        out_specs=(P(None, dspec, seq_axis, None), P()),
         axis_names=manual,
     )(x_mb, *stacked)
     return out.reshape(batch, *x.shape[1:]), aux

@@ -45,8 +45,9 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
       head count up to a multiple of the axis);
     * ``expert`` axis -> mixture-of-experts FFN sharded over it;
     * ``stage`` axis -> pipelined layer stack (one layer per stage when
-      the default depth doesn't divide); composes with ``model`` and
-      ``expert`` but not ``seq`` (nested shard_maps);
+      the default depth doesn't divide); composes with ``model``,
+      ``expert``, and ``seq`` (ring only — the seq axis joins the
+      pipeline's manual axes; ulysses is refused);
     * ``model`` axis -> Megatron tensor parallelism (annotation-only).
 
     Raises :class:`MeshConfigError` for un-runnable combinations.
@@ -86,12 +87,13 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
         n_heads = sp * -(-n_heads // sp)
     n_experts = axis_sizes.get("expert", 1)
     stages = axis_sizes.get("stage", 1)
-    if stages > 1 and sp > 1:
+    if stages > 1 and sp > 1 and attention == "ulysses":
+        # Ring rides the pipeline's manual axes (pp x sp composes);
+        # ulysses' all_to_all re-shard does not.
         raise MeshConfigError(
-            "mesh combines 'stage' with 'seq' — pipeline parallelism "
-            "does not compose with sequence-parallel attention "
-            "(ring/ulysses run their own shard_map); use one of the "
-            "two per mesh"
+            "mesh combines 'stage' with 'seq' but [payload] attention = "
+            "'ulysses' cannot ride the pipeline's shard_map; use "
+            "attention = \"ring\" on stage x seq meshes"
         )
     n_layers = PROBE_LAYERS
     if stages > 1 and n_layers % stages:

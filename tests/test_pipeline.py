@@ -156,8 +156,11 @@ def test_pipeline_rejects_indivisible_batch():
 def test_config_validation():
     with pytest.raises(ValueError, match="divide"):
         dataclasses.replace(PP_CFG, n_layers=3).validate()
-    with pytest.raises(ValueError, match="sequence-parallel"):
-        dataclasses.replace(PP_CFG, attention="ring").validate()
+    # pp x ring composes since round 3 — validate() must accept it;
+    # ulysses still cannot ride the pipeline's shard_map.
+    dataclasses.replace(PP_CFG, attention="ring").validate()
+    with pytest.raises(ValueError, match="ulysses"):
+        dataclasses.replace(PP_CFG, attention="ulysses").validate()
     with pytest.raises(ValueError, match="microbatches"):
         dataclasses.replace(PP_CFG, pipeline_microbatches=-2).validate()
     # pp x MoE composes since round 2 — validate() must accept it.
@@ -200,7 +203,32 @@ def test_transformer_probe_pp_tp_mesh(tmp_path):
     assert math.isfinite(result.probe_checksum)
 
 
-def test_probe_reports_clear_error_for_stage_plus_seq_mesh(tmp_path):
+def test_transformer_probe_stage_plus_seq_mesh_runs_ring(tmp_path):
+    """VERDICT r2 #3: the seq x stage cell is CONVERTED — a stage+seq
+    mesh runs the probe with ring attention riding the pipeline's
+    manual axes (was: a 'does not compose' rejection)."""
+    import math
+
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.runtime.workload import run_transformer_probe
+
+    cfg = dataclasses.replace(
+        RuntimeConfig(),
+        name="pp-sp-probe",
+        state_dir=str(tmp_path / "state"),
+        expected_platform="cpu",
+        status_port=0,
+        status_bind="127.0.0.1",
+        mesh=MeshSpec(axes=(("seq", 2), ("stage", 4))),
+    )
+    result = run_transformer_probe(cfg)
+    assert result.ok, result.error
+    assert math.isfinite(result.probe_checksum)
+
+
+def test_probe_reports_clear_error_for_stage_plus_seq_ulysses(tmp_path):
+    """Ulysses still cannot ride the pipeline's shard_map — refused with
+    an operator-facing message, never silently mis-sharded."""
     from kvedge_tpu.config.runtime_config import RuntimeConfig
     from kvedge_tpu.runtime.workload import run_transformer_probe
 
@@ -211,11 +239,12 @@ def test_probe_reports_clear_error_for_stage_plus_seq_mesh(tmp_path):
         expected_platform="cpu",
         status_port=0,
         status_bind="127.0.0.1",
+        payload_attention="ulysses",
         mesh=MeshSpec(axes=(("seq", 2), ("stage", 4))),
     )
     result = run_transformer_probe(cfg)
     assert not result.ok
-    assert "does not compose" in result.error
+    assert "ulysses" in result.error and "ring" in result.error
 
 
 def test_transformer_probe_pipeline_on_stage_mesh(tmp_path):
@@ -237,6 +266,111 @@ def test_transformer_probe_pipeline_on_stage_mesh(tmp_path):
     assert result.ok, result.error
     assert result.mesh_shape == (2, 4)
     assert math.isfinite(result.probe_checksum)
+
+
+def _pipeline_temp_bytes(*, micro, remat, layers=4):
+    """Compiled peak temp-buffer bytes of one pipelined grad step."""
+    import functools
+
+    cfg = dataclasses.replace(
+        PP_CFG, n_layers=layers, pipeline_microbatches=micro, remat=remat
+    )
+    mesh = pp_mesh()
+    params = shard_params(mesh, init_params(jax.random.PRNGKey(0), cfg))
+    batch = shard_batch(mesh, jax.random.randint(
+        jax.random.PRNGKey(1), (16, 33), 0, 128
+    ))
+    compiled = jax.jit(jax.grad(functools.partial(
+        loss_fn, cfg=cfg, mesh=mesh
+    ))).lower(params, batch).compile()
+    return compiled.memory_analysis().temp_size_in_bytes
+
+
+def test_pipeline_memory_claim_matches_measurement():
+    """VERDICT r2 #7: the docstring's memory story, measured. This is
+    GPipe + remat, not 1F1B: with remat the backward recomputes
+    activations, so peak temp memory is FLAT in the microbatch count
+    (M=S vs M=2S at fixed global batch) and flat in depth; without
+    remat the per-layer activation stash grows with depth."""
+    s = 4  # stages
+    remat_ms = _pipeline_temp_bytes(micro=s, remat=True)
+    remat_m2s = _pipeline_temp_bytes(micro=2 * s, remat=True)
+    assert remat_m2s < 1.3 * remat_ms, (remat_ms, remat_m2s)
+
+    # Remat bounds what GPipe would otherwise stash for backward.
+    no_remat = _pipeline_temp_bytes(micro=s, remat=False)
+    assert no_remat > 2 * remat_ms, (no_remat, remat_ms)
+
+    # Flat in depth with remat; growing with depth without.
+    remat_deep = _pipeline_temp_bytes(micro=s, remat=True, layers=8)
+    no_remat_deep = _pipeline_temp_bytes(micro=s, remat=False, layers=8)
+    assert remat_deep < 1.5 * remat_ms, (remat_ms, remat_deep)
+    assert no_remat_deep > 1.7 * no_remat, (no_remat, no_remat_deep)
+
+
+# ---- Pipeline x ring attention (VERDICT r2 #3: the seq x stage cell) -----
+#
+# The seq axis joins the pipeline's manual axes; the layer body offsets
+# rotary positions by the ring index and calls _ring_attention_local
+# directly (no nested shard_map). Property: same function as the plain
+# single-device scan with naive attention.
+
+RING_PP_CFG = dataclasses.replace(PP_CFG, attention="ring")
+
+RING_PP_MESHES = {
+    "pp-sp": (("stage", 4), ("seq", 2)),
+    "dp-pp-sp": (("data", 2), ("stage", 2), ("seq", 2)),
+}
+
+
+@pytest.mark.parametrize("axes", RING_PP_MESHES.values(),
+                         ids=RING_PP_MESHES.keys())
+def test_pipeline_ring_forward_matches_plain_scan(axes):
+    import functools
+
+    mesh = mesh_from(axes)
+    params = init_params(jax.random.PRNGKey(0), RING_PP_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+    got = jax.jit(functools.partial(forward, cfg=RING_PP_CFG, mesh=mesh))(
+        shard_params(mesh, params), tokens
+    )
+    want = forward(params, tokens, DENSE_CFG)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-4)
+
+
+def test_pipeline_ring_gradients_match_plain_scan():
+    import functools
+
+    mesh = mesh_from(RING_PP_MESHES["dp-pp-sp"])
+    params = init_params(jax.random.PRNGKey(0), RING_PP_CFG)
+    batch = jax.random.randint(jax.random.PRNGKey(2), (8, 33), 0, 128)
+    got = jax.jit(jax.grad(functools.partial(
+        loss_fn, cfg=RING_PP_CFG, mesh=mesh
+    )))(shard_params(mesh, params), shard_batch(mesh, batch))
+    want = jax.grad(loss_fn)(params, batch, DENSE_CFG)
+    for name in want:
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]), atol=5e-3,
+            err_msg=name,
+        )
+
+
+def test_pipeline_ring_train_step_runs_and_learns():
+    mesh = mesh_from(RING_PP_MESHES["dp-pp-sp"])
+    params = shard_params(
+        mesh, init_params(jax.random.PRNGKey(0), RING_PP_CFG)
+    )
+    init_opt, train_step = make_train_step(RING_PP_CFG, mesh=mesh)
+    opt_state = init_opt(params)
+    batch = shard_batch(mesh, jax.random.randint(
+        jax.random.PRNGKey(3), (8, 33), 0, 128
+    ))
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
 
 
 # ---- Pipeline x MoE (VERDICT r1 next-round #4: a converted ✗ cell) -------
