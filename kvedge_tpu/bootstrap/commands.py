@@ -78,6 +78,9 @@ def cmd_apply(argv: list[str], root: str) -> None:
         train_corpus=(
             rebase(cfg.train_corpus, root) if cfg.train_corpus else ""
         ),
+        eval_corpus=(
+            rebase(cfg.eval_corpus, root) if cfg.eval_corpus else ""
+        ),
     )
     cfg.apply(config_path=rebase(args.target, root))
 

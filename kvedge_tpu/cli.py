@@ -158,6 +158,12 @@ def cmd_corpus(args: argparse.Namespace) -> int:
     token ids, whitespace/newline separated — the format any external
     tokenizer can emit) or ``--random N`` (a seeded synthetic corpus for
     smoke tests and demos).
+
+    ``--holdout F`` (0 < F < 1) splits the stream's TAIL fraction into a
+    second file ``<out>.eval`` — the held-out split for the ``eval``
+    payload (``[payload] eval_corpus``). A sequential tail split, not a
+    shuffle: the corpus is a token stream, and shuffling would leak
+    training n-grams across the boundary.
     """
     import numpy as np
 
@@ -192,6 +198,30 @@ def cmd_corpus(args: argparse.Namespace) -> int:
         rng = np.random.default_rng(args.seed)
         tokens = rng.integers(0, args.vocab, size=args.random,
                               dtype=np.int32)
+    if args.holdout is not None:
+        if not 0.0 < args.holdout < 1.0:
+            raise ValueError("--holdout must be a fraction in (0, 1)")
+        n_eval = int(len(tokens) * args.holdout)
+        # Same discipline as the empty --from-tokens guard above: a split
+        # too small to feed even one seq=128 eval batch row would only
+        # fail later at pod boot (the feeder needs seq+1 tokens).
+        if n_eval < 129 or len(tokens) - n_eval < 129:
+            raise ValueError(
+                f"--holdout {args.holdout} of {len(tokens)} tokens "
+                f"leaves a split of {min(n_eval, len(tokens) - n_eval)} "
+                "tokens — too small to feed one default-seq (128) batch "
+                "row at pod boot; use more tokens or a different fraction"
+            )
+        eval_path = f"{args.out}.eval"
+        write_corpus(args.out, tokens[:-n_eval])
+        write_corpus(eval_path, tokens[-n_eval:])
+        print(
+            f"wrote {read_corpus_header(args.out)} tokens to {args.out} "
+            f"and {read_corpus_header(eval_path)} held-out tokens to "
+            f"{eval_path} (set [payload] eval_corpus to it)",
+            file=sys.stderr,
+        )
+        return 0
     write_corpus(args.out, tokens)
     print(
         f"wrote {read_corpus_header(args.out)} tokens to {args.out}",
@@ -243,6 +273,11 @@ def build_parser() -> argparse.ArgumentParser:
                           help="vocab for --random (default 512, the "
                                "train payload's model vocab)")
     p_corpus.add_argument("--seed", type=int, default=0)
+    p_corpus.add_argument(
+        "--holdout", type=float,
+        help="split this tail fraction (0 < F < 1) into <out>.eval — "
+             "the held-out corpus for the `eval` payload",
+    )
     p_corpus.set_defaults(func=cmd_corpus)
 
     p_package = sub.add_parser(

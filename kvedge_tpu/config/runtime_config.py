@@ -183,6 +183,11 @@ class RuntimeConfig:
     # across ALL pod generations — a rescheduled pod resumes from the
     # latest checkpoint and the feeder continues at the exact batch.
     train_corpus: str = ""
+    # Held-out corpus for the "eval" payload ([payload] eval_corpus).
+    # "" falls back to the TRAINING corpus — eval then reports training
+    # loss, not held-out loss, and says so loudly. Produce a split with
+    # `kvedge-tpu corpus --holdout 0.1` (writes <out> and <out>.eval).
+    eval_corpus: str = ""
     train_steps: int = 100
     train_batch: int = 8
     train_seq: int = 128
@@ -264,6 +269,9 @@ class RuntimeConfig:
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
+                eval_corpus=str(
+                    payload_doc.get("eval_corpus", cls.eval_corpus)
+                ),
                 train_steps=int(payload_doc.get("steps", cls.train_steps)),
                 train_batch=int(payload_doc.get("batch", cls.train_batch)),
                 train_seq=int(payload_doc.get("seq", cls.train_seq)),
@@ -315,11 +323,17 @@ class RuntimeConfig:
                 "[payload] serving_pages must be >= 0 (0 = auto-size so "
                 "every slot fits a worst-case request)"
             )
-        if self.payload in ("train", "eval") and not self.train_corpus:
+        if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
-                f"[payload] kind = {self.payload!r} requires corpus = "
-                "'<path>' (a KVFEED01 token file, typically on the state "
-                "volume)"
+                "[payload] kind = 'train' requires corpus = '<path>' "
+                "(a KVFEED01 token file, typically on the state volume)"
+            )
+        if self.payload == "eval" and not (self.train_corpus
+                                           or self.eval_corpus):
+            raise RuntimeConfigError(
+                "[payload] kind = 'eval' requires corpus = '<path>' or "
+                "eval_corpus = '<path>' (a KVFEED01 token file; "
+                "eval_corpus is the held-out split)"
             )
         for field_name in ("train_steps", "train_batch", "train_seq",
                            "train_checkpoint_every"):
@@ -368,6 +382,7 @@ class RuntimeConfig:
             f"serving_page_size = {self.serving_page_size}\n"
             f"serving_pages = {self.serving_pages}\n"
             f"corpus = {s(self.train_corpus)}\n"
+            f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"
             f"batch = {self.train_batch}\n"
             f"seq = {self.train_seq}\n"

@@ -452,9 +452,13 @@ def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     gradients, no optimizer, nothing written. The loss lands in
     ``probe_checksum`` (and therefore /status and the heartbeat), so an
     operator can read a checkpoint's quality from the same surface that
-    reports everything else. Use a held-out corpus file for honest
-    numbers; the batch order is the feeder's deterministic order from
-    batch 0.
+    reports everything else.
+
+    Held-out convention: ``[payload] eval_corpus`` names the held-out
+    split (produce one with ``kvedge-tpu corpus --holdout``); when it is
+    unset, eval falls back to the TRAINING corpus and warns loudly that
+    the number is training loss, not held-out loss. The batch order is
+    the feeder's deterministic order from batch 0 either way.
     """
     base = run_device_check(cfg)
     if not base.ok:
@@ -487,8 +491,18 @@ def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
             loss_fn, cfg=eval_tcfg,
             mesh=mesh if tcfg.needs_mesh else None,
         ))
+        corpus = cfg.eval_corpus or cfg.train_corpus
+        held_out = bool(cfg.eval_corpus)
+        if not held_out:
+            print(
+                "[kvedge-eval] WARNING: no [payload] eval_corpus set — "
+                "evaluating on the TRAINING corpus; this number is "
+                "training loss, NOT held-out loss (split one with "
+                "`kvedge-tpu corpus --holdout`)",
+                flush=True,
+            )
         feeder = open_feeder(
-            cfg.train_corpus, batch=local_rows, seq=cfg.train_seq,
+            corpus, batch=local_rows, seq=cfg.train_seq,
             global_batch=cfg.train_batch, shard_offset=shard_offset,
         )
         batches = _global_batches(cfg, tcfg, mesh, feeder, n_proc)
@@ -500,7 +514,7 @@ def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
         elapsed_ms = (time.perf_counter() - start) * 1000.0
         print(
             f"[kvedge-eval] checkpoint_step={step} batches="
-            f"{cfg.train_steps} loss={mean_loss:.4f} "
+            f"{cfg.train_steps} held_out={held_out} loss={mean_loss:.4f} "
             f"ppl={math.exp(min(mean_loss, 30.0)):.2f}",
             flush=True,
         )

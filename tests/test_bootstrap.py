@@ -81,6 +81,8 @@ def test_apply_command_rebases_state_dir(tmp_path):
     src.write_text(
         '[runtime]\nstate_dir = "/var/lib/kvedge/state"\n'
         '[tpu]\nplatform = "cpu"\n'
+        '[payload]\nkind = "eval"\ncorpus = "/state/c.kvfeed"\n'
+        'eval_corpus = "/state/c.kvfeed.eval"\n'
     )
     run_command(
         ("kvedge-bootstrap", "apply", "--source", "/userdata",
@@ -91,6 +93,10 @@ def test_apply_command_rebases_state_dir(tmp_path):
     text = applied.read_text()
     assert str(tmp_path / "var/lib/kvedge/state") in text
     assert (tmp_path / "var/lib/kvedge/state").is_dir()
+    # Every in-pod payload path rebases, not just state_dir — a missed
+    # one would escape the test root (or 404) at boot.
+    assert str(tmp_path / "state/c.kvfeed") in text
+    assert str(tmp_path / "state/c.kvfeed.eval") in text
 
 
 def test_apply_command_rejects_bad_config(tmp_path):

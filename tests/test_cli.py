@@ -99,6 +99,45 @@ def test_corpus_random_and_from_tokens(tmp_path, capsys):
     np.testing.assert_array_equal(next(feeder)[0], [5, 6, 7, 8, 9, 10, 11])
 
 
+def test_corpus_holdout_splits_tail(tmp_path, capsys):
+    import numpy as np
+
+    from kvedge_tpu.data import PyTokenFeeder, read_corpus_header
+
+    out = tmp_path / "c.kvfeed"
+    assert main(["corpus", "--out", str(out), "--random", "1000",
+                 "--holdout", "0.2"]) == 0
+    err = capsys.readouterr().err
+    assert "800 tokens" in err and "200 held-out" in err
+    assert read_corpus_header(out) == 800
+    assert read_corpus_header(f"{out}.eval") == 200
+
+    # The split is the sequential TAIL of the same stream: train tokens
+    # followed by eval tokens reconstruct the unsplit corpus.
+    whole = tmp_path / "w.kvfeed"
+    assert main(["corpus", "--out", str(whole), "--random", "1000"]) == 0
+    capsys.readouterr()
+
+    def tokens_of(path, n):
+        with PyTokenFeeder(path, batch=1, seq=n - 1) as f:
+            return np.asarray(next(iter(f))).ravel()[:n]
+
+    np.testing.assert_array_equal(
+        np.concatenate([tokens_of(out, 800), tokens_of(f"{out}.eval", 200)]),
+        tokens_of(whole, 1000),
+    )
+
+
+def test_corpus_holdout_rejects_bad_fractions(tmp_path, capsys):
+    out = str(tmp_path / "x.kvfeed")
+    assert main(["corpus", "--out", out, "--random", "100",
+                 "--holdout", "1.5"]) == 1
+    assert "fraction" in capsys.readouterr().err
+    assert main(["corpus", "--out", out, "--random", "300",
+                 "--holdout", "0.01"]) == 1
+    assert "too small" in capsys.readouterr().err
+
+
 def test_corpus_requires_exactly_one_source(tmp_path, capsys):
     out = str(tmp_path / "x.kvfeed")
     assert main(["corpus", "--out", out]) == 1

@@ -458,6 +458,41 @@ def test_eval_after_training_improves(tmp_path):
     assert trained.probe_checksum < fresh.probe_checksum
 
 
+def test_eval_warns_on_training_corpus_and_not_on_holdout(tmp_path, capsys):
+    """VERDICT r2 #8 done-bar: eval on a fresh held-out split reports
+    WITHOUT the training-loss warning; the fallback warns loudly."""
+    from kvedge_tpu.runtime.workload import run_eval_payload
+
+    corpus = _make_corpus(tmp_path)
+    heldout_dir = tmp_path / "h"
+    heldout_dir.mkdir()
+    heldout = _make_corpus(heldout_dir, seed=99)
+
+    result = run_eval_payload(_eval_cfg(tmp_path, corpus))
+    assert result.ok, result.error
+    out = capsys.readouterr().out
+    assert "WARNING" in out and "TRAINING corpus" in out
+    assert "held_out=False" in out
+
+    result = run_eval_payload(_eval_cfg(
+        tmp_path, corpus, eval_corpus=str(heldout)
+    ))
+    assert result.ok, result.error
+    out = capsys.readouterr().out
+    assert "WARNING" not in out
+    assert "held_out=True" in out
+
+
+def test_eval_accepts_eval_corpus_only(tmp_path):
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+
+    cfg = RuntimeConfig.parse(
+        "[payload]\nkind = \"eval\"\neval_corpus = \"/x.kvfeed\"\n"
+    )
+    assert cfg.eval_corpus == "/x.kvfeed"
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+
+
 def test_eval_requires_corpus():
     from kvedge_tpu.config.runtime_config import (
         RuntimeConfig,
