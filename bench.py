@@ -30,9 +30,12 @@ tools/bench_sweep.py and ad-hoc sweeps):
 Serving metrics: decode_tokens_per_sec drives the contiguous KV-cache
 greedy decode (models/decode.py, the whole loop one jitted scan) for the
 flagship shape in MHA and GQA (n_kv=2) forms, plus the per-token KV-cache
-HBM bill for each. The paged cache (models/kvcache.py) is host-orchestrated
-per token by design and is not timed here: through the relay a per-token
-host round trip measures dispatch latency, not the device.
+HBM bill for each. The paged continuous-batching path
+(models/kvcache.py) is timed as the server runs it — a host loop of
+batched ``cache.step`` calls at full slot occupancy — but with ONE hard
+sync at the end of the N-step window (greedy feedback stays on device),
+so dispatch pipelines and the number measures the device + table
+machinery, not N relay round trips.
 """
 
 from __future__ import annotations
@@ -178,6 +181,65 @@ def measure_decode(cfg, batch: int, prompt_len: int, n_new: int):
     return best
 
 
+PAGED_SLOTS = 4
+PAGED_PAGE_SIZE = 16
+
+
+def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
+                         page_size: int):
+    """Continuous-batching decode throughput: (tokens/sec, steps/sec).
+
+    VERDICT r2 #5: the paged path, measured. All ``slots`` sequences are
+    admitted + prefilled (full occupancy — the server's steady state
+    under load), then ``n_new`` batched ``cache.step`` calls run in one
+    timed window. Greedy feedback (argmax -> next token) stays on
+    device; the only host sync is one scalar fetch after the window, so
+    the relay's ~3 ms per-call dispatch pipelines instead of serializing
+    — the same discipline as :func:`measure`. Page-table growth and its
+    host->device table uploads happen inside the window exactly as they
+    do in production (every ``page_size`` steps per sequence).
+    """
+    from kvedge_tpu.models.kvcache import PagedKVCache
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pages = slots * -(-(prompt_len + n_new) // page_size)
+    prompts = jax.random.randint(
+        jax.random.PRNGKey(2), (slots, prompt_len), 0, cfg.vocab,
+        dtype=jnp.int32,
+    )
+
+    def run_window(cache) -> float:
+        """Admit/prefill every slot, run the n_new-step window, release.
+        Returns the window's wall-clock seconds (prefill excluded)."""
+        last_logits = []
+        for s in range(slots):
+            cache.admit(s, prompt_len)
+            last_logits.append(cache.prefill(params, s, prompts[s]))
+        tokens = jnp.argmax(jnp.stack(last_logits), axis=-1).astype(
+            jnp.int32
+        )
+        float(tokens.sum())  # sync: prefill work stays out of the window
+        start = time.perf_counter()
+        for _ in range(n_new):
+            logits = cache.step(params, tokens)
+            tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        float(tokens.sum())  # one hard sync for the whole window
+        elapsed = time.perf_counter() - start
+        for s in range(slots):
+            cache.release(s)
+        return elapsed
+
+    cache = PagedKVCache(
+        cfg, slots=slots, pages=pages, page_size=page_size
+    )
+    # Two warmup windows: compile (prefill + step programs), then absorb
+    # the relay's slow first execution (see measure()).
+    run_window(cache)
+    run_window(cache)
+    best = min(run_window(cache) for _ in range(2))
+    return slots * n_new / best, n_new / best
+
+
 def kv_cache_bytes_per_token(cfg) -> int:
     """Per-token KV-cache HBM bill: L layers x (K+V) x kv_heads x dh x bf16."""
     return cfg.n_layers * 2 * cfg.kv_heads * cfg.d_head * 2
@@ -258,6 +320,9 @@ def main() -> int:
     gqa = dataclasses.replace(FLAGSHIP, n_kv_heads=2)
     decode_mha = measure_decode(mha, DECODE_BATCH, DECODE_PROMPT, DECODE_NEW)
     decode_gqa = measure_decode(gqa, DECODE_BATCH, DECODE_PROMPT, DECODE_NEW)
+    paged_tps, paged_sps = measure_paged_decode(
+        gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE
+    )
     naive_ms, flash_ms, flash_speedup = measure_longcontext_attention()
     flash_big_ms = measure_flash_only(seq=8192, bh=64)
 
@@ -274,6 +339,9 @@ def main() -> int:
                 "peak_flops_per_chip": PEAK_FLOPS_PER_CHIP,
                 "decode_tokens_per_sec": round(decode_gqa, 1),
                 "decode_mha_tokens_per_sec": round(decode_mha, 1),
+                "paged_decode_tokens_per_sec": round(paged_tps, 1),
+                "paged_decode_steps_per_sec": round(paged_sps, 1),
+                "paged_decode_slots": PAGED_SLOTS,
                 "kv_cache_bytes_per_token_gqa": kv_cache_bytes_per_token(gqa),
                 "kv_cache_bytes_per_token_mha": kv_cache_bytes_per_token(mha),
                 "attn_t4096_naive_ms": round(naive_ms, 2),
