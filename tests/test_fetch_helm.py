@@ -1,0 +1,121 @@
+"""tools/fetch_helm.py: the pinned-fetch machinery, tested offline.
+
+The real-Helm conformance suite (test_real_helm.py) can only run where a
+helm binary exists; fetch_helm.py is how an egress-enabled machine gets
+one reproducibly. The fetch itself must therefore be trustworthy — these
+tests drive it against a local ``file://`` release fixture, so the
+verify/pin/cache logic is proven in THIS egress-less environment even
+though the real download cannot be.
+"""
+
+import hashlib
+import io
+import json
+import subprocess
+import sys
+import tarfile
+
+import pytest
+
+from tools import fetch_helm
+
+
+@pytest.fixture
+def release(tmp_path, monkeypatch):
+    """A fake helm release dir served over file://, with the module's
+    cache + lock redirected into tmp."""
+    plat = fetch_helm.host_platform()
+    version = "v9.9.9-test"
+    binary = b"#!/bin/sh\necho fake-helm\n"
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        info = tarfile.TarInfo(f"{plat}/helm")
+        info.size = len(binary)
+        tf.addfile(info, io.BytesIO(binary))
+    tarball = buf.getvalue()
+    name = f"helm-{version}-{plat}.tar.gz"
+    (tmp_path / name).write_bytes(tarball)
+    digest = hashlib.sha256(tarball).hexdigest()
+    (tmp_path / f"{name}.sha256sum").write_text(f"{digest}  {name}\n")
+
+    monkeypatch.setattr(fetch_helm, "CACHE_DIR", tmp_path / "bin")
+    monkeypatch.setattr(fetch_helm, "LOCK_PATH", tmp_path / "helm.lock")
+    return {
+        "base_url": f"file://{tmp_path}", "version": version,
+        "plat": plat, "digest": digest, "binary": binary,
+        "tmp": tmp_path, "name": name,
+    }
+
+
+def test_fetch_verifies_extracts_pins_and_caches(release, capsys):
+    rc = fetch_helm.main([
+        "--version", release["version"], "--base-url", release["base_url"],
+    ])
+    assert rc == 0
+    path = capsys.readouterr().out.strip()
+    assert path.endswith("/helm")
+    with open(path, "rb") as fh:
+        assert fh.read() == release["binary"]
+    # Executable, and the verified digests landed in the lock.
+    assert subprocess.run([path], capture_output=True,
+                          text=True).stdout.strip() == "fake-helm"
+    lock = json.loads(fetch_helm.LOCK_PATH.read_text())
+    entry = lock[f"{release['version']}/{release['plat']}"]
+    assert entry["sha256"] == release["digest"]
+    assert entry["binary_sha256"] == hashlib.sha256(
+        release["binary"]).hexdigest()
+
+    # Second call is a pure cache hit: point the base URL at nowhere to
+    # prove no network access happens.
+    rc = fetch_helm.main([
+        "--version", release["version"],
+        "--base-url", "file:///nonexistent", "--if-cached",
+    ])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == path
+
+
+def test_fetch_rejects_tampered_tarball(release, capsys):
+    tarball_path = release["tmp"] / release["name"]
+    tarball_path.write_bytes(tarball_path.read_bytes() + b"x")
+    rc = fetch_helm.main([
+        "--version", release["version"], "--base-url", release["base_url"],
+    ])
+    assert rc == fetch_helm.EXIT_FAIL
+    assert "sha256" in capsys.readouterr().err
+    assert not (fetch_helm.CACHE_DIR / f"helm-{release['version']}-"
+                f"{release['plat']}" / "helm").exists()
+
+
+def test_fetch_refuses_digest_differing_from_pin(release, capsys):
+    fetch_helm.LOCK_PATH.write_text(json.dumps({
+        f"{release['version']}/{release['plat']}": {
+            "sha256": "0" * 64, "binary_sha256": "0" * 64,
+            "source": "pinned-elsewhere",
+        }
+    }))
+    rc = fetch_helm.main([
+        "--version", release["version"], "--base-url", release["base_url"],
+    ])
+    assert rc == fetch_helm.EXIT_FAIL
+    assert "PINNED" in capsys.readouterr().err
+
+
+def test_if_cached_misses_cleanly(release, capsys):
+    rc = fetch_helm.main([
+        "--version", release["version"],
+        "--base-url", "file:///nonexistent", "--if-cached",
+    ])
+    assert rc == fetch_helm.EXIT_NO_CACHE
+    assert "no cached helm" in capsys.readouterr().err
+
+
+def test_tampered_cache_detected(release, capsys):
+    assert fetch_helm.main([
+        "--version", release["version"], "--base-url", release["base_url"],
+    ]) == 0
+    path = capsys.readouterr().out.strip()
+    with open(path, "ab") as fh:
+        fh.write(b"tamper")
+    with pytest.raises(RuntimeError, match="pinned digest"):
+        fetch_helm.cached_helm(release["version"], release["plat"])

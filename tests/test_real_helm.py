@@ -3,19 +3,25 @@
 helmlite (render/helmlite.py) and the Python renderer are pinned together
 by tests/test_chart_consistency.py — but both are in-repo implementations,
 so a Go-template/sprig semantic they implement identically wrong would be
-invisible. This suite runs the REAL ``helm template`` binary, when one is
-installed, over the same value matrix and asserts object-identity against
-both in-repo renderers. It skips cleanly where helm is absent (the build
-environment has none); any environment with helm on PATH — an operator
-laptop, a CI runner with helm installed — exercises it automatically, and
-a mismatch is a release blocker, not silent drift.
+invisible. This suite runs the REAL ``helm template`` binary over the same value
+matrix and asserts object-identity against both in-repo renderers. The
+binary comes from, in order: PATH; the checksum-pinned cache under
+``tools/bin`` (populated by ``tools/fetch_helm.py``); a live pinned
+fetch iff ``KVEDGE_FETCH_HELM=1`` (opt-in — tests must not touch the
+network by surprise). Where none of those produce a binary — this
+repo's own build environment has no helm AND zero network egress — the
+suite skips with that exact reason; any CI runner or operator laptop
+with egress exercises it via ``KVEDGE_FETCH_HELM=1``, and a mismatch is
+a release blocker, not silent drift.
 """
 
 import base64
 import json
+import os
 import pathlib
 import shutil
 import subprocess
+import sys
 
 import pytest
 import yaml
@@ -29,10 +35,36 @@ from kvedge_tpu.render.helmlite import Chart
 from tests.test_chart_consistency import VALUE_MATRIX
 
 CHART_DIR = pathlib.Path(__file__).parent.parent / "deployment" / "helm"
+FETCHER = pathlib.Path(__file__).parent.parent / "tools" / "fetch_helm.py"
 
-helm = shutil.which("helm")
+
+def _resolve_helm() -> str | None:
+    """PATH, then the pinned cache, then an opt-in pinned fetch."""
+    on_path = shutil.which("helm")
+    if on_path:
+        return on_path
+    argv = [sys.executable, str(FETCHER)]
+    if os.environ.get("KVEDGE_FETCH_HELM") != "1":
+        argv.append("--if-cached")
+    result = subprocess.run(argv, capture_output=True, text=True)
+    if result.returncode == 0:
+        return result.stdout.strip()
+    if "cache verification failed" in result.stderr:
+        # A tampered cached binary must fail the suite loudly — it is
+        # the exact event the pinning layer exists to surface, never a
+        # routine "no helm available" skip.
+        raise RuntimeError(result.stderr.strip())
+    return None
+
+
+helm = _resolve_helm()
 pytestmark = pytest.mark.skipif(
-    helm is None, reason="no helm binary on PATH (optional conformance run)"
+    helm is None,
+    reason=(
+        "no helm on PATH, none cached under tools/bin, and no "
+        "KVEDGE_FETCH_HELM=1 opt-in (or no network egress) for "
+        "tools/fetch_helm.py's pinned fetch"
+    ),
 )
 
 
