@@ -10,18 +10,28 @@ against; vs_baseline is reported as 1.0 by convention and the absolute
 throughput stands on its own. ``vs_r01`` tracks this repo's own round-1
 floor (246,669 tok/s) instead.
 
-Config provenance (round 2, all measured on the v5e chip via
-tools/bench_sweep.py and ad-hoc sweeps):
+Config provenance — machine-checkable in the committed SWEEP_r03.json
+(every variant's number: tools/bench_sweep.py --json) and its
+``breakdown`` section (tools/bench_breakdown.py):
 
-* attention="naive", remat=True/"full", batch 64/device was the best of
-  24 measured variants (flash/fused-xent/remat-off/dots all within -2%
-  to -27%). At seq 512 XLA's fused naive attention matches the Pallas
-  flash kernel (flash wins from T≈4096 up, its actual domain), and
-  remat=OFF is consistently SLOWER than remat=full here — XLA schedules
-  the rematerialized backward better than the activation-saving one.
-* The device sustains 119.5 TFLOP/s on a large bf16 matmul through this
-  relay (v5e nominal: 197). Against that measured rate the step's pure
-  matmul floor is ~91 ms; the shipped config runs ~125 ms. MFU below is
+* attention="naive", remat=True/"full", batch 64/device is the best of
+  the 36-variant r3 sweep (flash/fused-xent/remat-off/dots all -2% to
+  -27%; remat=off at bpd>=64 fails to compile). At seq 512 XLA's fused
+  naive attention matches the Pallas flash kernel (flash wins from
+  T≈4096 up, its actual domain), and remat=OFF is consistently SLOWER
+  than remat=full here — XLA schedules the rematerialized backward
+  better than the activation-saving one.
+* The ceiling claim, profiled (SWEEP_r03.json "breakdown"): the device
+  sustains 94-111 TF/s on a large scanned bf16 matmul through this
+  relay (session-dependent band; v5e nominal: 197; per-call timing
+  HALVES the apparent rate — the scan-amortized number is the
+  device's), putting the step's EXECUTED matmul floor (remat recompute
+  included) at ~98-116 ms against a ~128-134 ms step. The
+  session-stable anchor is the jax.profiler trace: dot_general busy
+  ~89 ms/step (an achieved ~123 TF/s — at/above the sustained
+  big-matmul band) plus ~33 ms of named non-dot device work
+  (reduce_sum/slice/scan machinery). The remaining headroom is in the
+  non-matmul ops, not un-harvested MXU throughput. MFU below is
   reported against the NOMINAL peak, the honest industry convention.
 * Steps run inside one jitted ``lax.scan`` (TIMED_STEPS per call): batch
   scaling showed a ~3 ms fixed dispatch cost per relay'd call, which a
@@ -72,10 +82,11 @@ DECODE_PROMPT = 64
 DECODE_NEW = 128
 
 
-def model_flops_per_token(cfg, seq: int) -> float:
-    """Useful train FLOPs per token (fwd + 2x bwd; remat recompute NOT
-    counted — MFU measures useful work). Attention counted unmasked, the
-    standard convention (PaLM-style accounting)."""
+def model_flops_parts(cfg, seq: int) -> tuple[float, float]:
+    """(layer-stack fwd FLOPs, readout fwd FLOPs) per token.
+
+    Split out so tools/bench_breakdown.py can account remat recompute
+    (layers re-run forward in backward; the readout does not)."""
     d, h, kv, dh, f = (cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.d_head,
                        cfg.d_ff)
     per_layer = (
@@ -85,8 +96,15 @@ def model_flops_per_token(cfg, seq: int) -> float:
         + 2 * h * dh * d            # output projection
         + 2 * d * f + 2 * f * d     # ffn up + down
     )
-    fwd = cfg.n_layers * per_layer + 2 * d * cfg.vocab  # + tied readout
-    return 3.0 * fwd
+    return cfg.n_layers * per_layer, 2 * d * cfg.vocab
+
+
+def model_flops_per_token(cfg, seq: int) -> float:
+    """Useful train FLOPs per token (fwd + 2x bwd; remat recompute NOT
+    counted — MFU measures useful work). Attention counted unmasked, the
+    standard convention (PaLM-style accounting)."""
+    layers, readout = model_flops_parts(cfg, seq)
+    return 3.0 * (layers + readout)
 
 
 def measure(cfg, batch_per_device: int, seq: int, steps: int,

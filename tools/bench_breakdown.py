@@ -1,0 +1,421 @@
+"""Where the train-step time goes: a measured decomposition + profile.
+
+VERDICT r2 #2: the "best of 24 variants, ~91 ms matmul floor vs ~125 ms
+actual" ceiling claim lived only in a docstring — not machine-checkable.
+This tool produces the committed evidence (merged into SWEEP_r{N}.json
+under ``"breakdown"``):
+
+* **Component timings** (always): the full step, forward-only,
+  forward+backward, optimizer-only, the attention stack alone, and the
+  readout+cross-entropy alone — each timed on-device with bench.py's
+  relay discipline (double warmup, scalar-fetch sync, best-of-N).
+* **Measured matmul ceiling**: the sustained bf16 matmul rate through
+  this relay (nominal 197 TF/s is NOT reachable; round 2 measured
+  ~119.5), from which the step's pure-matmul floor is derived.
+* **Profiler op categories** (when the xprof toolchain can parse the
+  captured trace): per-category device self-time from a real
+  ``jax.profiler`` trace of the timed step, so the decomposition above
+  is cross-checkable against what the device actually ran.
+
+Usage:  python tools/bench_breakdown.py [--json SWEEP_r03.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from bench import (  # noqa: E402
+    BATCH_PER_DEVICE,
+    SEQ,
+    TIMED_STEPS,
+    model_flops_parts,
+    model_flops_per_token,
+)
+from __graft_entry__ import FLAGSHIP, _factor_mesh  # noqa: E402
+from kvedge_tpu.models import init_params, loss_fn, make_train_step  # noqa: E402
+from kvedge_tpu.parallel import build_mesh, shard_batch, shard_params  # noqa: E402
+
+
+def _timed_ms(fn, *args, reps: int = 5, rounds: int = 2) -> float:
+    """Best-of-``rounds`` mean ms/call with the relay discipline: double
+    warmup (compile + the ~7x-slow first execution), one scalar fetch as
+    the sync. Inputs are never donated — every call reuses them."""
+    g = jax.jit(lambda *a: jax.tree_util.tree_reduce(
+        lambda acc, x: acc + jnp.sum(x).astype(jnp.float32), fn(*a),
+        jnp.float32(0),
+    ))
+    float(g(*args))
+    float(g(*args))
+    best = float("inf")
+    for _ in range(rounds):
+        start = time.perf_counter()
+        out = None
+        for _ in range(reps):
+            out = g(*args)
+        float(out)
+        best = min(best, (time.perf_counter() - start) / reps)
+    return best * 1000.0
+
+
+def measured_matmul_tflops(n: int = 8192, k: int = 20) -> float:
+    """Sustained bf16 matmul rate (TF/s): ``k`` dependent matmuls
+    scanned inside ONE jit (the carry rotates through the multiply so no
+    iteration can be elided), so the relay's per-call dispatch (~3 ms,
+    which HALVES the apparent rate of per-call timing at this size) is
+    amortized out and the number is the device's, not the transport's."""
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def many(a, b, reps):
+        def body(carry, _):
+            return b @ carry, ()
+        out, _ = lax.scan(body, a, None, length=reps)
+        return out
+
+    float(many(a, b, k).sum())
+    float(many(a, b, k).sum())
+    best = float("inf")
+    # Best of 8 windows: single cold windows through the relay were
+    # observed as much as ~15% low; the CEILING is what the floor
+    # arithmetic needs, so take the fastest sustained window.
+    for _ in range(8):
+        start = time.perf_counter()
+        float(many(a, b, k).sum())
+        best = min(best, time.perf_counter() - start)
+    return 2 * n**3 * k / best / 1e12
+
+
+def _setup(cfg, batch_per_device: int, seq: int, optimizer):
+    """One shared (mesh, params, opt_state, train_step, batch) build —
+    the flagship model is initialized and sharded onto the device ONCE
+    per run, for both the component timings and the profiler capture."""
+    devices = jax.devices()
+    n = len(devices)
+    mesh = build_mesh(_factor_mesh(n), devices=devices)
+    params = shard_params(mesh, init_params(jax.random.PRNGKey(0), cfg))
+    init_opt, train_step = make_train_step(
+        cfg, optimizer=optimizer, mesh=mesh if cfg.needs_mesh else None
+    )
+    opt_state = init_opt(params)
+    batch = shard_batch(mesh, jax.random.randint(
+        jax.random.PRNGKey(1), (batch_per_device * n, seq + 1), 0,
+        cfg.vocab, dtype=jnp.int32,
+    ))
+    # Mutable on purpose: train_step (and run_steps below) DONATE the
+    # params/opt_state buffers, so every consumer must write the fresh
+    # arrays back for the next one.
+    return {"mesh": mesh, "params": params, "opt_state": opt_state,
+            "train_step": train_step, "batch": batch}
+
+
+def component_timings(cfg, state, optimizer, batch_per_device: int,
+                      seq: int) -> dict:
+    """ms per (single) train step, decomposed. All at the headline shape."""
+    params, opt_state = state["params"], state["opt_state"]
+    train_step, batch = state["train_step"], state["batch"]
+    n = jax.device_count()
+
+    # Full step, measured exactly like bench.measure(): TIMED_STEPS steps
+    # scanned in one jit with the carry DONATED — the same program shape
+    # (and HBM footprint) as the headline number this explains.
+    @functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3,))
+    def run_steps(params, opt_state, batch, k):
+        def body(carry, _):
+            p, s = carry
+            p, s, loss = train_step(p, s, batch)
+            return (p, s), loss
+        (params, opt_state), losses = lax.scan(
+            body, (params, opt_state), None, length=k
+        )
+        return params, opt_state, losses[-1]
+
+    for _ in range(2):
+        params, opt_state, loss = run_steps(
+            params, opt_state, batch, TIMED_STEPS
+        )
+        float(loss)
+    best = float("inf")
+    for _ in range(2):
+        start = time.perf_counter()
+        params, opt_state, loss = run_steps(
+            params, opt_state, batch, TIMED_STEPS
+        )
+        float(loss)
+        best = min(best, time.perf_counter() - start)
+    step_ms = best * 1000.0 / TIMED_STEPS
+    state["params"], state["opt_state"] = params, opt_state
+
+    fwd_ms = _timed_ms(
+        functools.partial(loss_fn, cfg=cfg), params, batch, reps=5
+    )
+    grad_ms = _timed_ms(
+        jax.grad(functools.partial(loss_fn, cfg=cfg)), params, batch,
+        reps=3,
+    )
+
+    # Optimizer alone: apply updates to a param-shaped grad tree, with
+    # the SAME optimizer instance train_step uses (no re-declared
+    # hyperparameters to drift).
+    import optax
+
+    grads = jax.jit(jax.grad(functools.partial(loss_fn, cfg=cfg)))(
+        params, batch
+    )
+
+    def opt_only(grads, opt_state, params):
+        updates, new_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates)
+
+    opt_ms = _timed_ms(opt_only, grads, opt_state, params, reps=5)
+
+    # Attention stack alone (forward): n_layers naive-attention blocks at
+    # the step's [B, T, H, dh] shape — the non-matmul-floor suspect.
+    b, t = batch_per_device * n, seq
+    h, dh = cfg.n_heads, cfg.d_head
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(kq, (b, t, h, dh), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, t, h, dh), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, t, h, dh), jnp.bfloat16)
+
+    def attn_stack(q, k, v):
+        def one(carry, _):
+            qq, kk_, vv = carry
+            s = jnp.einsum("bqhd,bkhd->bhqk", qq, kk_) / (dh ** 0.5)
+            causal = jnp.tril(jnp.ones((t, t), jnp.bool_))
+            s = jnp.where(causal[None, None], s, jnp.finfo(qq.dtype).min)
+            w = jax.nn.softmax(s.astype(jnp.float32), -1).astype(qq.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", w, vv)
+            return (out, kk_, vv), ()
+        (out, _, _), _ = lax.scan(one, (q, k, v), None,
+                                  length=cfg.n_layers)
+        return out
+
+    attn_fwd_ms = _timed_ms(attn_stack, q, k, v, reps=3)
+
+    # Readout + cross-entropy alone at the step shape.
+    hidden = jax.random.normal(
+        jax.random.PRNGKey(3), (b * t, cfg.d_model), jnp.bfloat16
+    )
+    emb = jax.random.normal(
+        jax.random.PRNGKey(4), (cfg.vocab, cfg.d_model), jnp.float32
+    )
+    targets = jax.random.randint(
+        jax.random.PRNGKey(5), (b * t,), 0, cfg.vocab, jnp.int32
+    )
+
+    def readout_xent(hidden, emb, targets):
+        logits = jnp.dot(hidden, emb.T.astype(hidden.dtype),
+                         preferred_element_type=jnp.float32)
+        tl = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
+        return jnp.mean(jax.nn.logsumexp(logits, -1) - tl)
+
+    readout_ms = _timed_ms(readout_xent, hidden, emb, targets, reps=3)
+
+    return {
+        "step_ms": round(step_ms, 2),
+        "forward_ms": round(fwd_ms, 2),
+        "forward_backward_ms": round(grad_ms, 2),
+        "backward_ms": round(grad_ms - fwd_ms, 2),
+        "optimizer_ms": round(opt_ms, 2),
+        "attention_stack_fwd_ms": round(attn_fwd_ms, 2),
+        "readout_xent_fwd_ms": round(readout_ms, 2),
+    }
+
+
+def profiler_categories(state) -> dict | None:
+    """Device self-time by op category from a real jax.profiler trace.
+
+    Returns None (with a stderr note) when the xprof toolchain cannot
+    parse the capture — the component timings above stand alone.
+    """
+    import shutil
+
+    params, opt_state = state["params"], state["opt_state"]
+    train_step, batch = state["train_step"], state["batch"]
+    for _ in range(3):  # compile + settle before the capture window
+        params, opt_state, loss = train_step(params, opt_state, batch)
+        float(loss)
+
+    tmp = tempfile.mkdtemp(prefix="kvedge-breakdown-")
+    try:
+        with jax.profiler.trace(tmp):
+            for _ in range(3):
+                params, opt_state, loss = train_step(
+                    params, opt_state, batch
+                )
+                float(loss)
+        state["params"], state["opt_state"] = params, opt_state
+        xplanes = glob.glob(
+            os.path.join(tmp, "**", "*.xplane.pb"), recursive=True
+        )
+        if not xplanes:
+            print("no xplane captured; skipping profiler categories",
+                  file=sys.stderr)
+            return None
+        try:
+            from xprof.convert import raw_to_tool_data
+
+            data, _ = raw_to_tool_data.xspace_to_tool_data(
+                xplanes, "framework_op_stats", {"tqx": "out:json"}
+            )
+            doc = json.loads(data if isinstance(data, str)
+                             else data.decode())
+        except Exception as e:
+            print(f"xprof parse failed ({e!r}); skipping profiler "
+                  "categories", file=sys.stderr)
+            return None
+    finally:
+        # Traces of 3 full train steps run tens of MB; never leak them.
+        shutil.rmtree(tmp, ignore_errors=True)
+    # framework_op_stats JSON: a list of tables; [0] has one row per op
+    # with column ids rank/host_or_device/type/operation/total_self_time.
+    # Aggregate device self time by op type; IDLE (host gaps between the
+    # profiled Python-loop steps) is reported separately, not as work.
+    by_category: dict[str, float] = {}
+    top_ops: list[dict] = []
+    idle_us = 0.0
+    try:
+        table = doc[0]
+        ids = [c["id"] for c in table["cols"]]
+        i_dev = ids.index("host_or_device")
+        i_type = ids.index("type")
+        i_op = ids.index("operation")
+        i_self = ids.index("total_self_time")
+        for row in table["rows"]:
+            cells = [c.get("v") for c in row["c"]]
+            if cells[i_dev] != "Device":
+                continue
+            us = float(cells[i_self])
+            if cells[i_type] == "IDLE":
+                idle_us += us
+                continue
+            by_category[cells[i_type]] = (
+                by_category.get(cells[i_type], 0.0) + us
+            )
+            if len(top_ops) < 12:
+                top_ops.append({
+                    "op": cells[i_op], "type": cells[i_type],
+                    "self_us": round(us, 1),
+                })
+    except (KeyError, ValueError, IndexError, TypeError) as e:
+        print(f"unexpected framework_op_stats layout ({e!r})",
+              file=sys.stderr)
+        return None
+    total = sum(by_category.values()) or 1.0
+    return {
+        "source": "jax.profiler trace, xprof framework_op_stats, "
+                  "3 steps, device self-time (IDLE = host gaps between "
+                  "the profiled per-step dispatches, excluded from "
+                  "categories)",
+        "device_busy_us": round(total, 1),
+        "device_idle_us": round(idle_us, 1),
+        "categories_us": {
+            k: round(v, 1)
+            for k, v in sorted(by_category.items(),
+                               key=lambda kv: -kv[1])
+        },
+        "top_ops": top_ops,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", help="merge the breakdown into this sweep "
+                                   "artifact (SWEEP_r{N}.json)")
+    args = ap.parse_args()
+
+    import optax
+
+    cfg = FLAGSHIP  # the headline config: naive attention, remat=full
+    # The SAME optimizer make_train_step defaults to (transformer.py);
+    # built once here so the optimizer-only timing can reuse it.
+    optimizer = optax.adamw(3e-4, weight_decay=0.01)
+    state = _setup(cfg, BATCH_PER_DEVICE, SEQ, optimizer)
+    timings = component_timings(cfg, state, optimizer, BATCH_PER_DEVICE,
+                                SEQ)
+    tflops = measured_matmul_tflops()
+    tokens_step = BATCH_PER_DEVICE * jax.device_count() * SEQ
+    useful_step = model_flops_per_token(cfg, SEQ) * tokens_step
+    # EXECUTED matmul FLOPs per step, the number the device actually
+    # runs: remat=full re-runs each layer's forward inside backward
+    # (fwd + bwd(2x) + recompute = 4x layer fwd), while the readout sits
+    # outside the per-layer checkpoint (3x only).
+    layer_fwd, readout_fwd = model_flops_parts(cfg, SEQ)
+    executed_step = (4.0 * layer_fwd + 3.0 * readout_fwd) * tokens_step
+    floor_ms = executed_step / (tflops * 1e12) * 1000.0
+    profile = profiler_categories(state)
+
+    breakdown = {
+        "config": {
+            "attention": cfg.attention, "remat": cfg.remat,
+            "remat_policy": cfg.remat_policy,
+            "batch_per_device": BATCH_PER_DEVICE, "seq": SEQ,
+        },
+        "component_ms_note": (
+            "per-call jit timings: each call pays the relay's ~3 ms "
+            "dispatch and none of the scanned step's donation/scan "
+            "amortization, so components are NOT additive against "
+            "step_ms — the profiler categories below are the "
+            "authoritative in-step decomposition"
+        ),
+        "component_ms": timings,
+        "measured_matmul_tflops": round(tflops, 1),
+        "measured_matmul_tflops_note": (
+            "best-of-8 scanned windows in THIS run; the sustained rate "
+            "through the relay varies ~±10% across sessions (observed "
+            "94-111 TF/s in round 3), and the floor below inherits that "
+            "band — the profiler cross-check is the session-stable "
+            "anchor"
+        ),
+        "useful_flops_per_step": useful_step,
+        "executed_matmul_flops_per_step": executed_step,
+        "pure_matmul_floor_ms_executed": round(floor_ms, 2),
+        "step_minus_floor_ms": round(timings["step_ms"] - floor_ms, 2),
+        "profiler_op_categories": profile,
+    }
+    if profile is not None:
+        dot_ms = profile["categories_us"].get("dot_general", 0.0) / 3e3
+        nondot_ms = (profile["device_busy_us"] / 3e3) - dot_ms
+        breakdown["profiler_cross_check"] = {
+            "dot_general_ms_per_step": round(dot_ms, 2),
+            "non_dot_device_ms_per_step": round(nondot_ms, 2),
+            "achieved_dot_tflops": round(
+                executed_step / (dot_ms / 1e3) / 1e12, 1
+            ) if dot_ms else None,
+            "note": (
+                "achieved_dot_tflops ~ measured_matmul_tflops means the "
+                "matmuls already run at this relay's sustained ceiling; "
+                "the step's remaining time is the named non-dot device "
+                "work + per-step dispatch, not un-harvested matmul "
+                "throughput"
+            ),
+        }
+    print(json.dumps(breakdown, indent=1))
+    if args.json:
+        with open(args.json, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        doc["breakdown"] = breakdown
+        tmp = args.json + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+        os.replace(tmp, args.json)
+        print(f"merged breakdown into {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
