@@ -92,9 +92,10 @@ class TransformerConfig:
     # with ppermute rotation (parallel/ringattention.py); "ulysses"
     # shards the sequence too, but re-shards heads<->sequence with one
     # all-to-all each way and attends locally (parallel/ulysses.py —
-    # needs n_heads % sp == 0). Both sequence modes require passing a
-    # mesh with a ``seq`` axis to forward(). Flash requires seq to be a
-    # multiple of its block size.
+    # needs n_heads % (sp * tp) == 0; a ``model`` axis shards heads
+    # first). Both sequence modes require passing a mesh with a ``seq``
+    # axis to forward(). Flash requires seq to be a multiple of its
+    # block size.
     attention: str = "naive"
 
     @property

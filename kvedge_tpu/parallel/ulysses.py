@@ -20,11 +20,13 @@ time, Ulysses re-shards *once* in each direction:
 Trade-off vs the ring (why both exist): Ulysses moves Q/K/V/O exactly
 once over the all-to-all (cheap on a TPU slice where the ICI torus gives
 all-to-all high bisection bandwidth) and keeps the matmuls as one big
-MXU-friendly block per head — but its parallelism is capped at
-``n_heads`` (the ``seq`` axis must divide the head count), while the ring
-scales to any ``sp`` that divides the sequence and never materializes a
-full-sequence tensor on one device. Short-to-medium contexts with spare
-head parallelism favor Ulysses; extreme contexts favor the ring.
+MXU-friendly block per head — but its parallelism spends the HEAD
+dimension: a ``model`` tensor-parallel axis shards heads first and the
+``seq`` axis scatters each shard's remainder, so ``n_heads`` must divide
+by ``tp * sp`` — while the ring scales to any ``sp`` that divides the
+sequence and never materializes a full-sequence tensor on one device.
+Short-to-medium contexts with spare head parallelism favor Ulysses;
+extreme contexts (or head-poor models) favor the ring.
 
 Differentiability is free: ``all_to_all`` is its own transpose under
 reverse-mode, and the local attention is plain jnp.
@@ -84,14 +86,18 @@ def _ulysses_local(q, k, v, *, axis_name: str):
 
 
 def ulysses_attention(q, k, v, mesh, *, seq_axis: str = "seq",
-                      data_axis: str = "data"):
+                      data_axis: str = "data", model_axis: str = "model"):
     """Causal self-attention, sequence-sharded via all-to-all head scatter.
 
     q, k, v: [B, T, H, dh] (global shapes; rotary already applied). The
-    batch dim shards on ``data_axis``; ``n_heads`` must divide by the
-    ``seq_axis`` size (the all-to-all hands each device ``H/sp`` heads).
-    Unlike the ring, the head dim cannot *also* shard on a ``model`` axis:
-    Ulysses spends the head dimension on sequence parallelism.
+    batch dim shards on ``data_axis``. With a ``model_axis`` in the mesh
+    (sp x tp composition, the matrix cell converted in round 3), the
+    head dim shards over it FIRST — each device's all-to-all then
+    scatters its ``H/tp`` local heads over the ``seq_axis``, so
+    ``n_heads`` must divide by ``tp * sp`` (both axes are spent on the
+    head dimension; attention itself is per-head, so the model axis
+    needs no collective here — the qkv/out projections' Megatron psums
+    happen outside, exactly as with ring).
     """
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if seq_axis not in axis_sizes:
@@ -100,20 +106,23 @@ def ulysses_attention(q, k, v, mesh, *, seq_axis: str = "seq",
             "ulysses attention needs a sequence axis"
         )
     sp = axis_sizes[seq_axis]
+    tp = axis_sizes.get(model_axis, 1)
+    head_axis = model_axis if tp > 1 else None
     seq, heads = q.shape[1], q.shape[2]
     if seq % sp:
         raise ValueError(
             f"sequence length {seq} must divide by the {seq_axis!r} axis "
             f"size {sp}"
         )
-    if heads % sp:
+    if heads % (sp * tp):
         raise ValueError(
-            f"n_heads {heads} must divide by the {seq_axis!r} axis size "
-            f"{sp} — ulysses scatters heads over the sequence axis; use "
-            "ring attention when sp exceeds the head count"
+            f"n_heads {heads} must divide by {seq_axis!r} x "
+            f"{model_axis!r} = {sp} x {tp} — ulysses scatters each "
+            f"model shard's heads over the sequence axis; use ring "
+            "attention when the axes exceed the head count"
         )
     dspec = data_axis if data_axis in axis_sizes else None
-    spec = P(dspec, seq_axis, None, None)
+    spec = P(dspec, seq_axis, head_axis, None)
     local = functools.partial(_ulysses_local, axis_name=seq_axis)
     return jax.shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec

@@ -81,10 +81,11 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
             "and needs a 'seq' axis in the mesh"
         )
     n_heads = max(4, model_axis)
-    if attention == "ulysses" and n_heads % sp:
-        # Ulysses scatters heads over the seq axis: round up to the next
-        # multiple of sp.
-        n_heads = sp * -(-n_heads // sp)
+    if attention == "ulysses" and n_heads % (sp * model_axis):
+        # Ulysses scatters each model shard's heads over the seq axis:
+        # round up to the next multiple of sp x tp.
+        group = sp * model_axis
+        n_heads = group * -(-n_heads // group)
     n_experts = axis_sizes.get("expert", 1)
     stages = axis_sizes.get("stage", 1)
     if stages > 1 and sp > 1 and attention == "ulysses":
