@@ -319,9 +319,16 @@ class StatusServer:
                 except BrokenPipeError:
                     pass  # client went away; the request runs out server-side
                 except Exception as e:
+                    doc = {"error": repr(e)}
+                    # Multi-row streams attribute the failing row
+                    # (workload.py tags it), so clients can tell a
+                    # healthy row's truncation from its own failure.
+                    row = getattr(e, "stream_row", None)
+                    if row is not None:
+                        doc["row"] = row
                     try:
                         self.wfile.write(
-                            (json.dumps({"error": repr(e)}) + "\n").encode()
+                            (json.dumps(doc) + "\n").encode()
                         )
                     except OSError:
                         pass

@@ -690,8 +690,6 @@ def run_serve_payload(cfg: RuntimeConfig):
                     "the contiguous backend decodes the whole request as "
                     "one compiled program, so there is nothing to stream"
                 )
-            if stream and len(tokens) != 1:
-                raise ValueError("'stream' supports exactly one token row")
             if temperature < 0.0:
                 raise ValueError("'temperature' must be >= 0")
             if not 0.0 < top_p <= 1.0:
@@ -710,28 +708,104 @@ def run_serve_payload(cfg: RuntimeConfig):
                 from kvedge_tpu.runtime.status import GenerateUnavailable
 
                 if stream:
-                    row = [t % tcfg.vocab for t in tokens[0]]
-                    source = paged_server.submit_stream(
-                        row, n_new, sampling=row_sampling(0)
-                    )
-                    # Prime for the first token HERE, before the handler
-                    # commits a 200: admission failures (ServerBusy) must
-                    # surface as a clean 503 status, which is impossible
-                    # once streaming has started.
-                    try:
-                        first = next(source)
-                    except (ServerBusy, ServerClosed) as e:
-                        raise GenerateUnavailable(str(e)) from e
+                    import queue as queue_mod
+
+                    prompts = [[t % tcfg.vocab for t in row]
+                               for row in tokens]
+                    # Prime EVERY row for its first token HERE, before
+                    # the handler commits a 200: admission failures
+                    # (ServerBusy) must surface as a clean 503 status,
+                    # which is impossible once streaming has started.
+                    # Priming runs CONCURRENTLY — rows must submit
+                    # together to ride the same batched decode step
+                    # (same rationale as the non-stream path below); a
+                    # serial loop would add ~one prefill per row to
+                    # time-to-first-byte. (Rows beyond the slot count
+                    # admit as earlier rows finish; a timeout still 503s
+                    # cleanly — already-admitted rows decode out their
+                    # reserved budgets, which the server supports for
+                    # abandoned consumers.)
+                    sources: list = [None] * len(prompts)
+                    firsts: list = [None] * len(prompts)
+                    prime_errors: list = [None] * len(prompts)
+
+                    def prime(i):
+                        try:
+                            src = paged_server.submit_stream(
+                                prompts[i], n_new,
+                                sampling=row_sampling(i),
+                            )
+                            firsts[i] = next(src)
+                            sources[i] = src
+                        except Exception as e:
+                            prime_errors[i] = e
+
+                    primers = [
+                        threading.Thread(target=prime, args=(i,))
+                        for i in range(len(prompts))
+                    ]
+                    for p in primers:
+                        p.start()
+                    for p in primers:
+                        p.join()
+                    # Real faults outrank capacity conditions, same as
+                    # the non-stream path.
+                    for e in prime_errors:
+                        if e is not None and not isinstance(
+                            e, (ServerBusy, ServerClosed)
+                        ):
+                            raise e
+                    for e in prime_errors:
+                        if isinstance(e, (ServerBusy, ServerClosed)):
+                            raise GenerateUnavailable(str(e)) from e
+
+                    _ROW_DONE = object()
 
                     def ndjson():
-                        generated = [first]
-                        yield {"token": first}
-                        for token in source:
-                            generated.append(token)
-                            yield {"token": token}
+                        # Rows stream CONCURRENTLY, merged into one
+                        # ndjson sequence with per-row attribution: one
+                        # pump thread per row feeds a shared queue (the
+                        # generators block on the decode loop, so a
+                        # single-threaded round-robin would stall every
+                        # row behind the slowest).
+                        out_q = queue_mod.SimpleQueue()
+
+                        def pump(i):
+                            try:
+                                out_q.put((i, firsts[i]))
+                                for token in sources[i]:
+                                    out_q.put((i, token))
+                                out_q.put((i, _ROW_DONE))
+                            except Exception as e:
+                                out_q.put((i, e))
+
+                        pumps = [
+                            threading.Thread(target=pump, args=(i,),
+                                             daemon=True)
+                            for i in range(len(prompts))
+                        ]
+                        for p in pumps:
+                            p.start()
+                        generated = [[] for _ in prompts]
+                        live = len(prompts)
+                        while live:
+                            i, item = out_q.get()
+                            if item is _ROW_DONE:
+                                live -= 1
+                                continue
+                            if isinstance(item, Exception):
+                                # Attribute the failing row: the HTTP
+                                # layer's final {"error": ...} document
+                                # carries it (status.py), so healthy
+                                # rows' truncation is diagnosable.
+                                item.stream_row = i
+                                raise item
+                            generated[i].append(item)
+                            yield {"row": i, "token": item}
                         yield {
                             "done": True,
-                            "tokens": [row + generated],
+                            "tokens": [p + g for p, g
+                                       in zip(prompts, generated)],
                             "n_new": n_new,
                             "restored_step": restored_step,
                         }

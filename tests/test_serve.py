@@ -593,14 +593,28 @@ def test_http_generate_stream_rejected_on_contiguous_backend(tmp_path):
         serve_fn.close()
 
 
-def test_stream_rejects_multiple_rows(tmp_path):
+def test_stream_multiple_rows_merge_with_attribution(tmp_path):
+    """Multi-row streaming: rows decode concurrently, merged into one
+    ndjson sequence with per-row attribution; regrouping by row must
+    reproduce the non-streamed result exactly, and each row's tokens
+    arrive in generation order."""
     check, serve_fn = run_serve_payload(
         _cfg(tmp_path, payload_serving="paged")
     )
     assert check.ok
     try:
-        with pytest.raises(ValueError, match="one token row"):
-            serve_fn({"tokens": [[1, 2], [3, 4]], "n_new": 4,
-                      "stream": True})
+        req = {"tokens": [[5, 9, 2], [1, 1, 4]], "n_new": 5}
+        want = serve_fn(req)
+        out = serve_fn({**req, "stream": True})
+        docs = list(out["_stream"])
+        token_docs = [d for d in docs if "token" in d]
+        (final,) = [d for d in docs if d.get("done")]
+        assert len(token_docs) == 2 * 5
+        by_row = {0: [], 1: []}
+        for d in token_docs:
+            by_row[d["row"]].append(d["token"])
+        for i in (0, 1):
+            assert req["tokens"][i] + by_row[i] == want["tokens"][i]
+        assert final["tokens"] == want["tokens"]
     finally:
         serve_fn.close()
