@@ -707,6 +707,40 @@ def run_serve_payload(cfg: RuntimeConfig):
                 )
                 from kvedge_tpu.runtime.status import GenerateUnavailable
 
+                def fan_out_rows(n_rows: int, fn) -> None:
+                    """Run ``fn(i)`` per row in concurrent threads (rows
+                    must submit together to ride the same batched decode
+                    step), then apply the ONE error-priority policy:
+                    real faults surface first (HTTP 500), capacity
+                    conditions become GenerateUnavailable (503). Shared
+                    by the streamed and non-streamed paths so the two
+                    can never map the same server condition to different
+                    statuses."""
+                    errors: list = [None] * n_rows
+
+                    def guarded(i):
+                        try:
+                            fn(i)
+                        except Exception as e:
+                            errors[i] = e
+
+                    workers = [
+                        threading.Thread(target=guarded, args=(i,))
+                        for i in range(n_rows)
+                    ]
+                    for w in workers:
+                        w.start()
+                    for w in workers:
+                        w.join()
+                    for e in errors:
+                        if e is not None and not isinstance(
+                            e, (ServerBusy, ServerClosed)
+                        ):
+                            raise e
+                    for e in errors:
+                        if isinstance(e, (ServerBusy, ServerClosed)):
+                            raise GenerateUnavailable(str(e)) from e
+
                 if stream:
                     import queue as queue_mod
 
@@ -716,48 +750,21 @@ def run_serve_payload(cfg: RuntimeConfig):
                     # the handler commits a 200: admission failures
                     # (ServerBusy) must surface as a clean 503 status,
                     # which is impossible once streaming has started.
-                    # Priming runs CONCURRENTLY — rows must submit
-                    # together to ride the same batched decode step
-                    # (same rationale as the non-stream path below); a
-                    # serial loop would add ~one prefill per row to
-                    # time-to-first-byte. (Rows beyond the slot count
-                    # admit as earlier rows finish; a timeout still 503s
-                    # cleanly — already-admitted rows decode out their
-                    # reserved budgets, which the server supports for
-                    # abandoned consumers.)
+                    # (Rows beyond the slot count admit as earlier rows
+                    # finish; a timeout still 503s cleanly — already-
+                    # admitted rows decode out their reserved budgets,
+                    # which the server supports for abandoned consumers.)
                     sources: list = [None] * len(prompts)
                     firsts: list = [None] * len(prompts)
-                    prime_errors: list = [None] * len(prompts)
 
                     def prime(i):
-                        try:
-                            src = paged_server.submit_stream(
-                                prompts[i], n_new,
-                                sampling=row_sampling(i),
-                            )
-                            firsts[i] = next(src)
-                            sources[i] = src
-                        except Exception as e:
-                            prime_errors[i] = e
+                        src = paged_server.submit_stream(
+                            prompts[i], n_new, sampling=row_sampling(i)
+                        )
+                        firsts[i] = next(src)
+                        sources[i] = src
 
-                    primers = [
-                        threading.Thread(target=prime, args=(i,))
-                        for i in range(len(prompts))
-                    ]
-                    for p in primers:
-                        p.start()
-                    for p in primers:
-                        p.join()
-                    # Real faults outrank capacity conditions, same as
-                    # the non-stream path.
-                    for e in prime_errors:
-                        if e is not None and not isinstance(
-                            e, (ServerBusy, ServerClosed)
-                        ):
-                            raise e
-                    for e in prime_errors:
-                        if isinstance(e, (ServerBusy, ServerClosed)):
-                            raise GenerateUnavailable(str(e)) from e
+                    fan_out_rows(len(prompts), prime)
 
                     _ROW_DONE = object()
 
@@ -813,38 +820,14 @@ def run_serve_payload(cfg: RuntimeConfig):
                     return {"_stream": ndjson()}
 
                 rows: list = [None] * len(tokens)
-                errors: list = [None] * len(tokens)
 
-                def one_row(i, row):
-                    try:
-                        rows[i] = paged_server.submit(
-                            [t % tcfg.vocab for t in row], n_new,
-                            sampling=row_sampling(i),
-                        )
-                    except Exception as e:
-                        errors[i] = e
+                def one_row(i):
+                    rows[i] = paged_server.submit(
+                        [t % tcfg.vocab for t in tokens[i]], n_new,
+                        sampling=row_sampling(i),
+                    )
 
-                workers = [
-                    threading.Thread(target=one_row, args=(i, row))
-                    for i, row in enumerate(tokens)
-                ]
-                for w in workers:
-                    w.start()
-                for w in workers:
-                    w.join()
-                # Real faults outrank capacity conditions: a decode
-                # exception in one row must surface as the 500 it is,
-                # not hide behind another row's retryable 503.
-                for e in errors:
-                    if e is not None and not isinstance(
-                        e, (ServerBusy, ServerClosed)
-                    ):
-                        raise e
-                for e in errors:
-                    if isinstance(e, (ServerBusy, ServerClosed)):
-                        # Retryable capacity condition, not a server
-                        # fault: surface as 503, not 500.
-                        raise GenerateUnavailable(str(e)) from e
+                fan_out_rows(len(tokens), one_row)
                 return {
                     "tokens": rows,
                     "n_new": n_new,
