@@ -25,8 +25,10 @@ from kvedge_tpu.models.decode import (
     generate,
 )
 from kvedge_tpu.models.kvcache import PagedKVCache, PagedCacheError
+from kvedge_tpu.models.speculative import generate_speculative
 
 __all__ = [
+    "generate_speculative",
     "TransformerConfig",
     "init_params",
     "forward",

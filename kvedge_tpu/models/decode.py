@@ -130,8 +130,16 @@ def _attend_layer(cfg: TransformerConfig, x, layer_params, k_slab, v_slab,
     return x, k_slab, v_slab
 
 
-def _run_layers(cfg: TransformerConfig, params: dict, x, cache: KVCache, pos):
-    """Scan the layer stack, threading each layer's cache slab through xs/ys."""
+def _run_layers(cfg: TransformerConfig, params: dict, x, cache: KVCache,
+                pos, all_positions: bool = False):
+    """Scan the layer stack, threading each layer's cache slab through xs/ys.
+
+    ``all_positions=True`` reads out logits at EVERY query position
+    (speculative verification needs the argmax after each drafted
+    token); the default reads only the last (prefill/decode). One
+    definition of the layer pipeline for both, so the speculative
+    path's numerics can never drift from plain decode's.
+    """
 
     def body(carry, xs):
         layer_params, k_slab, v_slab = xs
@@ -143,9 +151,11 @@ def _run_layers(cfg: TransformerConfig, params: dict, x, cache: KVCache, pos):
     x, (new_k, new_v) = lax.scan(
         body, x, (stacked_layer_params(params, cfg), cache.k, cache.v)
     )
-    x = _rmsnorm(x, params["ln_final"])
-    logits = tied_readout(x[:, -1], params["embedding"])
     new_cache = KVCache(k=new_k, v=new_v, length=pos + x.shape[1])
+    x = _rmsnorm(x, params["ln_final"])
+    logits = tied_readout(
+        x if all_positions else x[:, -1], params["embedding"]
+    )
     return logits, new_cache
 
 

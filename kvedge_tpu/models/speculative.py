@@ -1,0 +1,183 @@
+"""Prompt-lookup speculative decoding: K drafted tokens, one verify pass.
+
+The serving latency lever the README's future-work list called for
+(greedy decode emits one token per model forward; speculation emits up
+to ``draft_len + 1``). The draft model is the CONTEXT itself — n-gram
+("prompt lookup") drafting: find the most recent earlier occurrence of
+the current bigram and propose the tokens that followed it. Free (no
+second model), and strong exactly where autoregressive serving is slow:
+summarization, code edits, retrieval-augmented generation — anything
+whose output re-uses spans of its input.
+
+Greedy speculation is EXACT: a draft is accepted only where it equals
+the model's own greedy argmax, so output is token-for-token identical to
+:func:`~kvedge_tpu.models.decode.generate` (pinned by
+tests/test_speculative.py) — speculation changes the schedule, never the
+text. Bad drafts only cost speed.
+
+TPU-first shape discipline, same as decode.py:
+
+* The ENTIRE generation is one compiled program: prefill, then a
+  ``lax.while_loop`` of draft -> verify -> accept steps. All shapes are
+  static (the draft length is a compile-time constant; acceptance moves
+  a scalar length, never a shape); the loop is data-dependent only in
+  its trip count, which ``while_loop`` exists for.
+* Verification reuses the decode cache machinery: one
+  ``_attend_layer`` pass over ``1 + K`` query positions against the
+  donated KV slabs. Rejected drafts leave garbage K/V beyond the
+  accepted length — harmless by construction: causal masking never
+  attends past the query positions, and the next verify step's write
+  window provably covers every garbage position before it can be read.
+* Drafting is pure ``jnp`` (vectorized bigram match + one
+  ``dynamic_slice``), fused into the same program — no host round trip
+  per token group.
+
+Reference parity: the reference has no inference path at all
+(SURVEY.md §0); this extends the serving capability lane
+(decode -> paged continuous batching -> streaming -> speculation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kvedge_tpu.models.decode import (
+    KVCache,
+    _run_layers,
+    init_cache,
+    prefill,
+)
+from kvedge_tpu.models.transformer import TransformerConfig
+
+
+def _propose_ngram(ctx, length, k: int):
+    """Draft ``k`` tokens from the context's own history (one row).
+
+    ctx: [S] int32 (prompt + accepted tokens, junk beyond ``length``).
+    Finds the most recent position ``p < length - 2`` where
+    ``ctx[p:p+2]`` equals the current final bigram and proposes
+    ``ctx[p+2 : p+2+k]``; with no match, repeats the last token (any
+    guess is legal — verification makes correctness draft-independent).
+    """
+    s = ctx.shape[0]
+    idx = jnp.arange(s)
+    g0 = jnp.take(ctx, length - 2)
+    g1 = jnp.take(ctx, length - 1)
+    match = (ctx == g0) & (jnp.roll(ctx, -1) == g1) & (idx < length - 2)
+    p = jnp.max(jnp.where(match, idx, -1))
+    start = jnp.clip(p + 2, 0, s - k)
+    draft = lax.dynamic_slice(ctx, (start,), (k,))
+    return jnp.where(p >= 0, draft, jnp.full((k,), g1, ctx.dtype))
+
+
+def _verify(params, cache: KVCache, tokens, cfg: TransformerConfig):
+    """One forward over ``[1, 1+K]`` positions against the cache.
+
+    ``tokens`` = [last accepted token, draft_0 .. draft_{K-1}]. Returns
+    (greedy argmax at EVERY position [1, 1+K], cache advanced by 1+K) —
+    the caller rewinds ``length`` to the accepted prefix; the garbage
+    K/V beyond it is overwritten by the next step's window (see module
+    docstring). Runs decode.py's own layer pipeline
+    (``_run_layers(all_positions=True)``) so the numerics are the same
+    code path as plain decode.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embedding"][tokens].astype(dtype)  # [1, 1+K, D]
+    logits, new_cache = _run_layers(
+        cfg, params, x, cache, cache.length, all_positions=True
+    )  # [1, 1+K, V]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_new", "draft_len"))
+def generate_speculative(params: dict, prompt, cfg: TransformerConfig,
+                         n_new: int, draft_len: int = 4):
+    """Greedy-decode ``n_new`` tokens with prompt-lookup speculation.
+
+    prompt: [1, T] int32 — speculation is a single-sequence latency
+    optimization (ragged per-row acceptance does not batch; throughput
+    workloads want the paged server instead). Returns
+    ``([1, T + n_new] int32, accepted_per_step fp32)`` where the second
+    value is the mean tokens emitted per VERIFY pass (the prefill's
+    first token is excluded; 1.0 = speculation never paid,
+    ``draft_len + 1`` = every draft accepted, 0.0 = no verify pass ran
+    i.e. ``n_new == 1``) — the observability hook for whether
+    speculation pays on a workload.
+
+    Token-for-token identical to ``generate(...)`` greedy output, with
+    one precisely-scoped caveat: verification computes its logits with
+    ``1+K``-query matmuls where plain decode uses single-query ones, so
+    a vocab pair whose fp32-accumulated logits tie EXACTLY could break
+    the argmax differently. Tests pin exactness in fp32 and bf16; for
+    trained models an exact tie is measure-zero, and a tie-break
+    difference selects an equally-ranked token, never a worse one.
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            "speculative decoding is single-sequence (got batch "
+            f"{prompt.shape[0]}); use generate()/the paged server for "
+            "batched throughput"
+        )
+    k = draft_len
+    prompt_len = prompt.shape[1]
+    # Slack beyond n_new: a verify window may extend past the final
+    # needed token; clamped writes must never shift onto real tokens.
+    cache = init_cache(cfg, 1, max_seq=prompt_len + n_new + k + 1)
+    logits, cache = prefill(params, prompt, cache, cfg)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1]
+
+    ctx0 = jnp.zeros((prompt_len + n_new + k + 1,), jnp.int32)
+    ctx0 = lax.dynamic_update_slice(ctx0, prompt[0], (0,))
+    ctx0 = ctx0.at[prompt_len].set(first[0])
+    out0 = jnp.zeros((n_new + k + 1,), jnp.int32)
+    out0 = out0.at[0].set(first[0])
+
+    def cond(state):
+        produced, *_ = state
+        return produced < n_new
+
+    def step(state):
+        produced, steps, ctx, out, cache = state
+        length = prompt_len + produced
+        draft = _propose_ngram(ctx, length, k)  # [K]
+        last = jnp.take(ctx, length - 1)
+        tokens = jnp.concatenate([last[None], draft])[None]  # [1, 1+K]
+        y, cache = _verify(params, cache, tokens, cfg)
+        y = y[0]  # [1+K]: y[i] = greedy token after position i
+        accepted = jnp.sum(
+            jnp.cumprod((draft == y[:k]).astype(jnp.int32))
+        )  # leading agreement, in [0, K]
+        # Emitted this step: the accepted drafts then the bonus token
+        # (the model's own argmax after them) — junk beyond that is
+        # provably overwritten by the next step's window.
+        emitted = jnp.where(
+            jnp.arange(k + 1) < accepted, jnp.concatenate([draft, y[-1:]]),
+            jnp.take(y, accepted),
+        ).astype(jnp.int32)
+        out = lax.dynamic_update_slice(out, emitted, (produced,))
+        ctx = lax.dynamic_update_slice(ctx, emitted, (length,))
+        # Valid K/V now covers [0, length + accepted): the verify pass
+        # wrote `last` + the drafts; the accepted prefix is last + a
+        # drafts. The BONUS token's K/V is not written yet — it is the
+        # next step's `last`, exactly like plain decode's final token.
+        cache = dataclasses.replace(cache, length=length + accepted)
+        return produced + accepted + 1, steps + 1, ctx, out, cache
+
+    produced, steps, _, out, _ = lax.while_loop(
+        cond, step, (jnp.int32(1), jnp.int32(0), ctx0, out0, cache)
+    )
+    tokens = jnp.concatenate([prompt[0], out[:n_new]])[None]
+    # Verify passes only: the prefill's first token is not a pass, so
+    # the draft_len + 1 ceiling is actually reachable.
+    rate = jnp.where(
+        steps > 0,
+        (produced - 1).astype(jnp.float32)
+        / jnp.maximum(steps, 1).astype(jnp.float32),
+        0.0,
+    )
+    return tokens, rate

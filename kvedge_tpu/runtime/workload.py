@@ -696,6 +696,36 @@ def run_serve_payload(cfg: RuntimeConfig):
                 raise ValueError("'top_p' must be in (0, 1]")
             sampled = temperature > 0.0
             base_key = jax.random.PRNGKey(seed) if sampled else None
+            # Speculative decoding ('speculative': K = draft length):
+            # greedy, single-row, contiguous-backend — a latency lever,
+            # token-for-token identical to plain greedy decode
+            # (models/speculative.py).
+            spec = doc.get("speculative", 0)
+            if (not isinstance(spec, int) or isinstance(spec, bool)
+                    or not 0 <= spec <= 16):
+                raise ValueError(
+                    "'speculative' must be an integer draft length in "
+                    "[0, 16] (0 = off)"
+                )
+            if spec:
+                if paged_server is not None:
+                    raise ValueError(
+                        "'speculative' runs on the contiguous backend; "
+                        "this runtime serves [payload] serving = \"paged\""
+                    )
+                if stream:
+                    raise ValueError(
+                        "'speculative' does not compose with 'stream'"
+                    )
+                if len(tokens) != 1:
+                    raise ValueError(
+                        "'speculative' supports exactly one token row"
+                    )
+                if sampled:
+                    raise ValueError(
+                        "'speculative' is greedy-only (temperature 0): "
+                        "drafts verify against the argmax"
+                    )
             if paged_server is not None:
                 # Continuous batching: each row is its own request into
                 # the shared page pool, submitted CONCURRENTLY so the
@@ -834,6 +864,22 @@ def run_serve_payload(cfg: RuntimeConfig):
                     "restored_step": restored_step,
                 }
             prompt = jnp.asarray(tokens, jnp.int32) % tcfg.vocab
+            if spec:
+                from kvedge_tpu.models import generate_speculative
+
+                with lock:
+                    out, rate = generate_speculative(
+                        params, prompt, tcfg, n_new=n_new, draft_len=spec
+                    )
+                return {
+                    "tokens": [[int(t) for t in out.tolist()[0]]],
+                    "n_new": n_new,
+                    "restored_step": restored_step,
+                    # Observability: mean tokens emitted per verify pass
+                    # (1.0 = speculation never paid; draft_len + 1 =
+                    # every draft accepted).
+                    "accepted_per_step": round(float(rate), 3),
+                }
             sampling = None
             if sampled:
                 seed_keys = jax.vmap(

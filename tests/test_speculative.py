@@ -1,0 +1,156 @@
+"""Prompt-lookup speculative decoding vs plain greedy decode.
+
+The contract is EXACTNESS: speculation changes the schedule (up to
+draft_len + 1 tokens per model forward), never the text — greedy output
+must be token-for-token identical to ``generate()`` on every input, or
+the feature is silently corrupting served generations. Acceptance-rate
+behavior (repetitive inputs accept more) is the payoff property.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kvedge_tpu.models import (
+    TransformerConfig,
+    generate,
+    generate_speculative,
+    init_params,
+)
+
+CFG = TransformerConfig(
+    vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=256,
+    dtype="float32",
+)
+
+
+def _params(cfg=CFG, seed=0):
+    return init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _random_prompt(seed=1, length=16):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (1, length), 0, CFG.vocab,
+        dtype=jnp.int32,
+    )
+
+
+@pytest.mark.parametrize("draft_len", [1, 3, 4, 8])
+def test_speculative_exactly_matches_greedy_decode(draft_len):
+    params = _params()
+    prompt = _random_prompt()
+    want = generate(params, prompt, CFG, n_new=24)
+    got, rate = generate_speculative(
+        params, prompt, CFG, n_new=24, draft_len=draft_len
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert float(rate) >= 1.0  # every verify pass emits at least 1 token
+
+
+def test_speculative_matches_on_repetitive_input_and_accepts_more():
+    """The payoff property: self-repeating input drafts well, so the
+    mean tokens-per-verify must beat the random-input rate — while the
+    output stays exactly the greedy decode."""
+    params = _params()
+    rep = jnp.tile(jnp.asarray([[7, 3, 9, 1]], jnp.int32), (1, 6))
+    want = generate(params, rep, CFG, n_new=32)
+    got, rep_rate = generate_speculative(params, rep, CFG, n_new=32)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    _, rnd_rate = generate_speculative(
+        params, _random_prompt(), CFG, n_new=32
+    )
+    assert float(rep_rate) > float(rnd_rate)
+    assert float(rep_rate) > 1.5  # genuinely speculating, not degenerate
+
+
+def test_speculative_matches_in_bf16():
+    """The serving default dtype: exactness must hold in bf16 compute
+    too (logits are fp32-accumulated on both paths; see the module
+    docstring for the exact-tie caveat this pins against in practice)."""
+    cfg = dataclasses.replace(CFG, dtype="bfloat16")
+    params = _params(cfg, seed=5)
+    prompt = _random_prompt(seed=6)
+    want = generate(params, prompt, cfg, n_new=24)
+    got, _ = generate_speculative(params, prompt, cfg, n_new=24)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_accepted_per_step_counts_verify_passes_only():
+    params = _params()
+    # n_new == 1: no verify pass ran — the metric must say so, not 1.0.
+    _, rate = generate_speculative(params, _random_prompt(), CFG, n_new=1)
+    assert float(rate) == 0.0
+    # With verify passes, each emits at least one token.
+    _, rate = generate_speculative(params, _random_prompt(), CFG, n_new=16)
+    assert float(rate) >= 1.0
+
+
+def test_speculative_matches_with_gqa():
+    cfg = dataclasses.replace(CFG, n_kv_heads=2)
+    params = _params(cfg, seed=2)
+    prompt = _random_prompt(seed=3)
+    want = generate(params, prompt, cfg, n_new=16)
+    got, _ = generate_speculative(params, prompt, cfg, n_new=16)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_single_token_and_short_prompt_edges():
+    params = _params()
+    # n_new=1: the while_loop body never runs.
+    prompt = _random_prompt(seed=4)
+    want = generate(params, prompt, CFG, n_new=1)
+    got, rate = generate_speculative(params, prompt, CFG, n_new=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # 1-token prompt: the bigram lookup degenerates to the fallback.
+    tiny = jnp.asarray([[5]], jnp.int32)
+    want = generate(params, tiny, CFG, n_new=8)
+    got, _ = generate_speculative(params, tiny, CFG, n_new=8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_rejects_batches():
+    params = _params()
+    batch = jnp.zeros((2, 8), jnp.int32)
+    with pytest.raises(ValueError, match="single-sequence"):
+        generate_speculative(params, batch, CFG, n_new=4)
+
+
+def test_serve_speculative_request_flag(tmp_path):
+    """The serving surface: 'speculative': K returns the same tokens as
+    the plain request plus the accepted_per_step observability field;
+    invalid combinations are rejected."""
+    import dataclasses as dc
+
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.runtime.workload import run_serve_payload
+
+    cfg = dc.replace(
+        RuntimeConfig(), name="spec-serve", state_dir=str(tmp_path / "s"),
+        expected_platform="cpu", status_port=0, status_bind="127.0.0.1",
+        payload="serve", train_seq=32,
+    )
+    check, serve_fn = run_serve_payload(cfg)
+    assert check.ok, check.error
+    try:
+        plain = serve_fn({"tokens": [[3, 1, 4, 1, 3, 1]], "n_new": 8})
+        spec = serve_fn({"tokens": [[3, 1, 4, 1, 3, 1]], "n_new": 8,
+                         "speculative": 4})
+        assert spec["tokens"] == plain["tokens"]
+        assert spec["accepted_per_step"] >= 1.0
+
+        for bad in (
+            {"tokens": [[1, 2]], "n_new": 2, "speculative": -1},
+            {"tokens": [[1, 2]], "n_new": 2, "speculative": 99},
+            {"tokens": [[1, 2]], "n_new": 2, "speculative": True},
+            {"tokens": [[1, 2], [3, 4]], "n_new": 2, "speculative": 2},
+            {"tokens": [[1, 2]], "n_new": 2, "speculative": 2,
+             "temperature": 0.7},
+        ):
+            with pytest.raises(ValueError):
+                serve_fn(bad)
+    finally:
+        serve_fn.close()
