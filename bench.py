@@ -270,6 +270,12 @@ def measure_speculative(cfg, prompt_len: int, n_new: int,
     it this input reached. Returns (spec_tps, plain_tps, accepted)."""
     from kvedge_tpu.models import generate_speculative, init_params
 
+    if prompt_len % 16:
+        raise ValueError(
+            f"prompt_len {prompt_len} must be a multiple of the 16-token "
+            "repeat pattern (a silent truncation would bench the wrong "
+            "prompt)"
+        )
     params = init_params(jax.random.PRNGKey(0), cfg)
     pattern = jax.random.randint(
         jax.random.PRNGKey(3), (1, 16), 0, cfg.vocab, dtype=jnp.int32

@@ -173,10 +173,13 @@ def generate_speculative(params: dict, prompt, cfg: TransformerConfig,
     )
     tokens = jnp.concatenate([prompt[0], out[:n_new]])[None]
     # Verify passes only: the prefill's first token is not a pass, so
-    # the draft_len + 1 ceiling is actually reachable.
+    # the draft_len + 1 ceiling is actually reachable. Clamped at n_new:
+    # the final pass may overshoot the budget, and tokens the client
+    # never received must not inflate the acceleration metric.
+    delivered = jnp.minimum(produced, n_new)
     rate = jnp.where(
         steps > 0,
-        (produced - 1).astype(jnp.float32)
+        (delivered - 1).astype(jnp.float32)
         / jnp.maximum(steps, 1).astype(jnp.float32),
         0.0,
     )

@@ -708,14 +708,18 @@ def run_serve_payload(cfg: RuntimeConfig):
                     "[0, 16] (0 = off)"
                 )
             if spec:
+                # Stream check FIRST: on a paged runtime (the only
+                # place 'stream' is legal) the composition error is the
+                # clearer message; after the paged check it would be
+                # unreachable.
+                if stream:
+                    raise ValueError(
+                        "'speculative' does not compose with 'stream'"
+                    )
                 if paged_server is not None:
                     raise ValueError(
                         "'speculative' runs on the contiguous backend; "
                         "this runtime serves [payload] serving = \"paged\""
-                    )
-                if stream:
-                    raise ValueError(
-                        "'speculative' does not compose with 'stream'"
                     )
                 if len(tokens) != 1:
                     raise ValueError(

@@ -49,5 +49,6 @@ def test_cast_covers_the_end_to_end_story():
         "boot_count is now 2",          # state survived rescheduling
         "train payload ok",             # real resumable training ran
         "restored_step=4",              # serve restored the checkpoint
+        "same tokens: True",            # speculative decode is exact
     ):
         assert landmark in transcript, f"missing landmark: {landmark!r}"

@@ -70,6 +70,11 @@ def main() -> int:
     out = serve_fn({"tokens": [[5, 9, 2, 7]], "n_new": 6})
     print(f"POST /generate -> restored_step={out['restored_step']} "
           f"tokens={out['tokens'][0]}")
+    spec = serve_fn({"tokens": [[5, 9, 2, 7]], "n_new": 6,
+                     "speculative": 4})
+    print(f"POST /generate (speculative: 4) -> same tokens: "
+          f"{spec['tokens'] == out['tokens']}, "
+          f"accepted_per_step={spec['accepted_per_step']}")
     print("serving the trained checkpoint: restored_step matches the "
           "training target")
     return 0
