@@ -112,6 +112,23 @@ def test_speculative_single_token_and_short_prompt_edges():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_speculative_matches_with_tp_sharded_params():
+    """A serve runtime on a tensor-parallel mesh restores sharded params
+    (workload.py); the speculative while_loop must run under those
+    shardings with unchanged output."""
+    from kvedge_tpu.config.runtime_config import MeshSpec
+    from kvedge_tpu.parallel import build_mesh, shard_params
+
+    params = _params()
+    mesh = build_mesh(MeshSpec(axes=(("data", 2), ("model", 4))))
+    prompt = jnp.tile(jnp.asarray([[7, 3, 9, 1]], jnp.int32), (1, 6))
+    want = generate(params, prompt, CFG, n_new=16)
+    got, _ = generate_speculative(
+        shard_params(mesh, params), prompt, CFG, n_new=16
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_speculative_rejects_batches():
     params = _params()
     batch = jnp.zeros((2, 8), jnp.int32)
