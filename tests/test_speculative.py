@@ -121,7 +121,7 @@ def test_speculative_matches_with_tp_sharded_params():
 
     params = _params()
     mesh = build_mesh(MeshSpec(axes=(("data", 2), ("model", 4))))
-    prompt = jnp.tile(jnp.asarray([[7, 3, 9, 1]], jnp.int32), (1, 6))
+    prompt = _random_prompt(seed=7)
     want = generate(params, prompt, CFG, n_new=16)
     got, _ = generate_speculative(
         shard_params(mesh, params), prompt, CFG, n_new=16
