@@ -110,6 +110,41 @@ def test_if_cached_misses_cleanly(release, capsys):
     assert "no cached helm" in capsys.readouterr().err
 
 
+def test_tarball_only_lock_entry_is_not_tamper(release, capsys):
+    """A hand-written lock entry pinning only the tarball digest must not
+    brick the cache path — no binary pin means "unverifiable", not
+    "tampered"."""
+    assert fetch_helm.main([
+        "--version", release["version"], "--base-url", release["base_url"],
+    ]) == 0
+    path = capsys.readouterr().out.strip()
+    lock = json.loads(fetch_helm.LOCK_PATH.read_text())
+    del lock[f"{release['version']}/{release['plat']}"]["binary_sha256"]
+    fetch_helm.LOCK_PATH.write_text(json.dumps(lock))
+    cached = fetch_helm.cached_helm(release["version"], release["plat"])
+    assert cached is not None and str(cached) == path
+    assert "unverified" in capsys.readouterr().err
+
+    # An entry missing even the tarball digest must not crash a re-fetch
+    # with a KeyError; it re-pins as if first-use.
+    lock = json.loads(fetch_helm.LOCK_PATH.read_text())
+    lock[f"{release['version']}/{release['plat']}"] = {"source": "partial"}
+    fetch_helm.LOCK_PATH.write_text(json.dumps(lock))
+    import shutil
+    shutil.rmtree(fetch_helm.CACHE_DIR)
+    assert fetch_helm.main([
+        "--version", release["version"], "--base-url", release["base_url"],
+    ]) == 0
+    assert "PINNING (first use)" in capsys.readouterr().err
+
+
+def test_first_use_pin_prints_tofu_notice(release, capsys):
+    assert fetch_helm.main([
+        "--version", release["version"], "--base-url", release["base_url"],
+    ]) == 0
+    assert "PINNING (first use)" in capsys.readouterr().err
+
+
 def test_tampered_cache_detected(release, capsys):
     assert fetch_helm.main([
         "--version", release["version"], "--base-url", release["base_url"],

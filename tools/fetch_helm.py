@@ -16,6 +16,18 @@ Integrity model (two layers):
   (version, platform) must reproduce the SAME digest, so a compromised
   mirror cannot silently swap binaries once any machine has pinned one.
 
+The FIRST fetch of any (version, platform) is trust-on-first-use: both
+the tarball and its ``.sha256sum`` come from the same origin, so a
+compromised mirror contacted first gets its digest pinned. A
+pre-populated ``helm.lock`` would close that window, but this repo is
+developed in a zero-egress environment where the upstream digests
+cannot be fetched (and committing unverified digests from memory would
+brick verification of *correct* binaries). Mitigations instead: the
+tool prints a loud ``PINNING (first use)`` notice whenever it records a
+new digest, and an operator with egress should run the first fetch
+against ``https://get.helm.sh`` directly (never a mirror), then commit
+the updated lock.
+
 The build environment this repo is developed in has zero network egress
 (pypi/get.helm.sh unresolvable — verified round 3), so the conformance
 suite skips there with a reason pointing here; any CI runner or operator
@@ -66,12 +78,22 @@ def cached_helm(version: str, plat: str) -> pathlib.Path | None:
     if not path.is_file():
         return None
     pinned = read_lock().get(lock_key(version, plat))
-    if pinned is not None:
+    if pinned is not None and pinned.get("binary_sha256") is None:
+        # Keep the degraded-verification state visible without bricking
+        # the path: no binary pin means this cache hit is UNVERIFIED.
+        print(
+            f"warning: lock entry for {lock_key(version, plat)} has no "
+            "binary_sha256 — returning cached binary unverified",
+            file=sys.stderr,
+        )
+    if pinned is not None and pinned.get("binary_sha256") is not None:
         # The lock pins the TARBALL digest; the binary's own digest is
         # recorded next to it at extract time so a cache tamper is
-        # detected without re-downloading.
+        # detected without re-downloading. An entry that pins only the
+        # tarball (hand-written / older format) simply has no binary pin
+        # to check — that is "unverifiable", not "tampered".
         digest = hashlib.sha256(path.read_bytes()).hexdigest()
-        if digest != pinned.get("binary_sha256"):
+        if digest != pinned["binary_sha256"]:
             raise RuntimeError(
                 f"cached {path} does not match the pinned digest in "
                 f"{LOCK_PATH}; delete it and re-fetch"
@@ -115,6 +137,10 @@ def fetch_helm(version: str, plat: str, base_url: str) -> pathlib.Path:
     lock = read_lock()
     key = lock_key(version, plat)
     pinned = lock.get(key)
+    if pinned is not None and pinned.get("sha256") is None:
+        # Partial hand-written entry with no tarball digest: nothing to
+        # compare against, so this fetch re-pins below as if first-use.
+        pinned = None
     if pinned is not None and pinned["sha256"] != digest:
         raise RuntimeError(
             f"{name}: sha256 {digest} does not match the PINNED digest "
@@ -130,6 +156,13 @@ def fetch_helm(version: str, plat: str, base_url: str) -> pathlib.Path:
     dest.write_bytes(binary)
     dest.chmod(dest.stat().st_mode | stat.S_IXUSR | stat.S_IXGRP)
 
+    if pinned is None:
+        print(
+            f"PINNING (first use): {key} sha256={digest} from {base_url} — "
+            "trust-on-first-use; fetch from https://get.helm.sh directly "
+            f"and commit {LOCK_PATH.name}",
+            file=sys.stderr,
+        )
     lock[key] = {
         "sha256": digest,
         "binary_sha256": hashlib.sha256(binary).hexdigest(),
