@@ -97,6 +97,72 @@ class MeshSpec:
         return tuple(sizes)
 
 
+# Model-shape presets the [model] section may name; the shape tables
+# themselves live with the model (kvedge_tpu/models/transformer.py
+# PRESETS) — this module stays importable without jax.
+_VALID_PRESETS = ("", "probe", "flagship")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """The payload model's architecture ([model] TOML section).
+
+    The reference's most distinctive mechanism is an *opaque payload
+    config* pipeline so the operator controls what the payload runs
+    (reference ``_helper.tpl:61-74``, ``values.yaml:13-14``); here the
+    model IS the payload, so its shape belongs in the same TOML. A
+    ``preset`` names a base shape ("probe" — the tiny default — or
+    "flagship", the 41.6M-param bench model); any explicitly-set field
+    overrides the preset. Zero means "from the preset" (and for
+    ``n_heads``/``experts``, "adapted to the mesh" — see
+    runtime/workload.py derive_model_config). Explicitly-set values are
+    authoritative: a mesh they cannot run on is *refused* with a clear
+    error, never silently adjusted.
+    """
+
+    preset: str = ""  # "" = "probe"
+    vocab: int = 0
+    d_model: int = 0
+    n_heads: int = 0
+    # 0 here means "from the preset" (both presets are MHA); an explicit
+    # value enables grouped-query attention (models/decode.py KV-cache
+    # shrink by n_heads/n_kv_heads).
+    n_kv_heads: int = 0
+    n_layers: int = 0
+    d_ff: int = 0
+    # Mixture-of-experts expert count; 0 = derived from the mesh's
+    # ``expert`` axis (dense when the mesh has none).
+    experts: int = 0
+    expert_top_k: int = 0  # 0 = 1 (Switch); 2 = GShard top-2
+    # 0.0 = provably drop-free capacity (factor * top_k >= experts).
+    expert_capacity_factor: float = 0.0
+
+    def validate(self) -> None:
+        if self.preset not in _VALID_PRESETS:
+            raise RuntimeConfigError(
+                f"[model] preset must be one of {_VALID_PRESETS[1:]}, "
+                f"got {self.preset!r}"
+            )
+        for field_name in ("vocab", "d_model", "n_heads", "n_kv_heads",
+                           "n_layers", "d_ff", "experts", "expert_top_k"):
+            value = getattr(self, field_name)
+            if not isinstance(value, int) or isinstance(value, bool) \
+                    or value < 0:
+                raise RuntimeConfigError(
+                    f"[model] {field_name} must be a non-negative int "
+                    "(0 = from the preset)"
+                )
+        if self.expert_capacity_factor < 0:
+            raise RuntimeConfigError(
+                "[model] expert_capacity_factor must be >= 0 "
+                "(0 = drop-free capacity)"
+            )
+        if self.expert_top_k not in (0, 1, 2):
+            raise RuntimeConfigError(
+                "[model] expert_top_k must be 1 or 2 (0 = default 1)"
+            )
+
+
 @dataclasses.dataclass(frozen=True)
 class DistributedSpec:
     """Multi-host topology the runtime should join at boot.
@@ -145,6 +211,7 @@ class RuntimeConfig:
     expected_platform: str = "tpu"
     expected_chips: int = 0  # 0 = accept whatever is visible
     mesh: MeshSpec = MeshSpec()
+    model: ModelSpec = ModelSpec()
     distributed: DistributedSpec = DistributedSpec()
     status_port: int = 8476
     status_bind: str = "0.0.0.0"
@@ -207,6 +274,7 @@ class RuntimeConfig:
         runtime = dict(doc.get("runtime", {}))
         tpu = dict(doc.get("tpu", {}))
         mesh_doc = dict(doc.get("mesh", {}))
+        model_doc = dict(doc.get("model", {}))
         dist_doc = dict(doc.get("distributed", {}))
         status = dict(doc.get("status", {}))
         payload_doc = dict(doc.get("payload", {}))
@@ -229,6 +297,27 @@ class RuntimeConfig:
                 expected_platform=str(tpu.get("platform", cls.expected_platform)),
                 expected_chips=int(tpu.get("expected_chips", cls.expected_chips)),
                 mesh=MeshSpec(axes=tuple(axes)),
+                model=ModelSpec(
+                    preset=str(model_doc.get("preset", ModelSpec.preset)),
+                    vocab=int(model_doc.get("vocab", ModelSpec.vocab)),
+                    d_model=int(model_doc.get("d_model", ModelSpec.d_model)),
+                    n_heads=int(model_doc.get("n_heads", ModelSpec.n_heads)),
+                    n_kv_heads=int(
+                        model_doc.get("n_kv_heads", ModelSpec.n_kv_heads)
+                    ),
+                    n_layers=int(
+                        model_doc.get("n_layers", ModelSpec.n_layers)
+                    ),
+                    d_ff=int(model_doc.get("d_ff", ModelSpec.d_ff)),
+                    experts=int(model_doc.get("experts", ModelSpec.experts)),
+                    expert_top_k=int(
+                        model_doc.get("expert_top_k", ModelSpec.expert_top_k)
+                    ),
+                    expert_capacity_factor=float(
+                        model_doc.get("expert_capacity_factor",
+                                      ModelSpec.expert_capacity_factor)
+                    ),
+                ),
                 distributed=DistributedSpec(
                     num_processes=int(
                         dist_doc.get("num_processes",
@@ -343,6 +432,7 @@ class RuntimeConfig:
                     f"[payload] {toml_key} must be positive"
                 )
         self.mesh.validate()
+        self.model.validate()
         self.distributed.validate()
 
     def to_toml(self) -> str:
@@ -365,6 +455,17 @@ class RuntimeConfig:
             f"expected_chips = {self.expected_chips}\n"
             "\n[mesh]\n"
             f"axes = {{ {axes} }}\n"
+            "\n[model]\n"
+            f"preset = {s(self.model.preset)}\n"
+            f"vocab = {self.model.vocab}\n"
+            f"d_model = {self.model.d_model}\n"
+            f"n_heads = {self.model.n_heads}\n"
+            f"n_kv_heads = {self.model.n_kv_heads}\n"
+            f"n_layers = {self.model.n_layers}\n"
+            f"d_ff = {self.model.d_ff}\n"
+            f"experts = {self.model.experts}\n"
+            f"expert_top_k = {self.model.expert_top_k}\n"
+            f"expert_capacity_factor = {self.model.expert_capacity_factor}\n"
             "\n[distributed]\n"
             f"num_processes = {self.distributed.num_processes}\n"
             f"coordinator_address = {s(self.distributed.coordinator_address)}\n"

@@ -9,6 +9,7 @@ depth), static shapes, and Megatron-style dp×tp sharding via the rules in
 """
 
 from kvedge_tpu.models.transformer import (
+    PRESETS,
     TransformerConfig,
     init_params,
     forward,
@@ -29,6 +30,7 @@ from kvedge_tpu.models.speculative import generate_speculative
 
 __all__ = [
     "generate_speculative",
+    "PRESETS",
     "TransformerConfig",
     "init_params",
     "forward",

@@ -134,20 +134,31 @@ class PagedKVCache:
         (:meth:`step`) must :meth:`_sync` before the next device step when
         any table changed; stale device tables would scatter the new token
         into another sequence's page."""
+        return self.grow_to(slot, 1)
+
+    def grow_to(self, slot: int, n: int) -> bool:
+        """Ensure the slot can hold ``n`` more tokens (the device-side
+        decode window's scatters land at positions length..length+n-1),
+        allocating pages as needed. Early allocation is safe by the
+        serving layer's admission discipline: every request's worst-case
+        page budget is reserved up front, so pages pulled here were
+        already accounted for. Returns True iff any page was allocated
+        (caller must :meth:`_sync`)."""
         if slot not in self._pages_of:
             raise PagedCacheError(f"slot {slot} is not admitted")
         length = self._host_lengths[slot]
         pages = self._pages_of[slot]
-        if length + 1 <= len(pages) * self.page_size:
-            return False
-        if len(pages) == self.max_pages_per_seq:
-            raise PagedCacheError(f"slot {slot} hit max_pages_per_seq")
-        if not self._free:
-            raise PagedCacheError("pool exhausted mid-decode")
-        page = self._free.pop()
-        pages.append(page)
-        self._host_tables[slot][len(pages) - 1] = page
-        return True
+        grew = False
+        while length + n > len(pages) * self.page_size:
+            if len(pages) == self.max_pages_per_seq:
+                raise PagedCacheError(f"slot {slot} hit max_pages_per_seq")
+            if not self._free:
+                raise PagedCacheError("pool exhausted mid-decode")
+            page = self._free.pop()
+            pages.append(page)
+            self._host_tables[slot][len(pages) - 1] = page
+            grew = True
+        return grew
 
     def release(self, slot: int) -> None:
         """Finish a sequence: return its pages to the pool."""
@@ -210,6 +221,37 @@ class PagedKVCache:
         for slot in active:
             self._host_lengths[slot] += 1
         return logits
+
+    def step_window(self, params, tokens, n_steps: int):
+        """``n_steps`` greedy decode steps in ONE dispatched program.
+
+        The per-token host round trip is the paged path's tax: page
+        tables only change at page boundaries, so between boundaries the
+        decode loop is a pure device-side recurrence — scan it. Pages
+        for the whole window are allocated up front (legal because the
+        serving layer reserves each request's worst-case budget at
+        admission), the greedy argmax feeds back inside the scan, and
+        the host pays one dispatch + one transfer for ``n_steps`` tokens
+        instead of ``n_steps`` of each.
+
+        ``tokens`` is [slots] int32 (each active slot's pending token).
+        Returns generated tokens [n_steps, slots]; row ``i`` is the
+        token produced by feeding row ``i-1`` (row 0 fed ``tokens``).
+        Greedy only — sampled slots need the per-step path (their key
+        schedule folds a host-side step index).
+        """
+        active = [s for s in self._pages_of]
+        grew = False
+        for slot in active:
+            grew |= self.grow_to(slot, n_steps)
+        if grew:
+            self._sync()
+        toks, self.state = _paged_decode_window(
+            params, self.state, tokens, self.cfg, n_steps
+        )
+        for slot in active:
+            self._host_lengths[slot] += n_steps
+        return toks
 
 
 # ---- jitted kernels ------------------------------------------------------
@@ -347,9 +389,11 @@ def _paged_prefill(params: dict, state: PagedState, prompt, slot,
     return logits[0], dataclasses.replace(state, pool_k=new_k, pool_v=new_v)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
-def _paged_decode_step(params: dict, state: PagedState, tokens,
-                       cfg: TransformerConfig):
+def _decode_step_core(params: dict, state: PagedState, tokens,
+                      cfg: TransformerConfig):
+    """One batched decode step (traceable body shared by the jitted
+    single step and the windowed scan — the two must stay the same
+    program so windowed and per-step decode agree token for token)."""
     dtype = jnp.dtype(cfg.dtype)
     x = params["embedding"][tokens][:, None].astype(dtype)  # [B, 1, D]
     q_positions = state.lengths[:, None]  # [B, 1]
@@ -361,3 +405,31 @@ def _paged_decode_step(params: dict, state: PagedState, tokens,
         pool_v=new_v,
         lengths=state.lengths + active.astype(jnp.int32),
     )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _paged_decode_step(params: dict, state: PagedState, tokens,
+                       cfg: TransformerConfig):
+    return _decode_step_core(params, state, tokens, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_steps"),
+                   donate_argnums=(1,))
+def _paged_decode_window(params: dict, state: PagedState, tokens,
+                         cfg: TransformerConfig, n_steps: int):
+    """``n_steps`` decode steps with greedy feedback, one program.
+
+    The scan carries (state, pending token); each step feeds the pending
+    token and emits its greedy successor. Inactive slots produce garbage
+    tokens that are never read (their scatters drop, their lengths hold).
+    """
+    def body(carry, _):
+        state, toks = carry
+        logits, state = _decode_step_core(params, state, toks, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (state, nxt), nxt
+
+    (state, _), produced = jax.lax.scan(
+        body, (state, tokens), length=n_steps
+    )
+    return produced, state

@@ -251,6 +251,25 @@ class PagedGenerationServer:
         if req.stream is not None:
             req.stream.put(token)
 
+    def _window_steps(self) -> int:
+        """Steps the next device-side decode window may run (lock held).
+
+        Bounded by the tightest remaining budget MINUS the pending token
+        (which the finish-check emits without a step), so no slot ever
+        decodes past its budget; capped at page_size and floored to a
+        power of two so the set of compiled window programs stays small
+        ({2, 4, ..., page_size}). Sampled requests force the per-step
+        path: their key schedule folds a host-side step index per token.
+        """
+        if any(req.sampling is not None for req in self._active.values()):
+            return 1
+        w = min(req.n_new - len(req.generated) - 1
+                for req in self._active.values())
+        w = min(w, self._cache.page_size)
+        if w <= 1:
+            return 1
+        return 1 << (w.bit_length() - 1)
+
     def _next_tokens(self, logits) -> dict[int, int]:
         """Every active slot's next token from the step's [slots, V]
         logits — ONE batched argmax plus (when any request samples) ONE
@@ -335,6 +354,23 @@ class PagedGenerationServer:
                     tokens = np.zeros((self._cache.slots,), np.int32)
                     for slot, req in self._active.items():
                         tokens[slot] = req.next_token
+                    window = self._window_steps()
+                    if window > 1:
+                        # Device-side window: `window` greedy steps in
+                        # one dispatched scan (kvcache.step_window) —
+                        # the host pays one round trip per window, not
+                        # per token. Admission re-syncs between windows
+                        # (a submitter blocks on this lock until the
+                        # window returns, then joins the next one).
+                        produced = np.asarray(self._cache.step_window(
+                            self._params, jnp.asarray(tokens), window
+                        ))
+                        for slot, req in self._active.items():
+                            self._emit(req, req.next_token)
+                            for i in range(window - 1):
+                                self._emit(req, int(produced[i, slot]))
+                            req.next_token = int(produced[window - 1, slot])
+                        continue
                     logits = self._cache.step(
                         self._params, jnp.asarray(tokens)
                     )

@@ -104,6 +104,18 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
     @property
+    def param_count(self) -> int:
+        """Exact parameter count of the tree init_params builds."""
+        d, f, L, v = self.d_model, self.d_ff, self.n_layers, self.vocab
+        h, kv, dh = self.n_heads, self.kv_heads, self.d_head
+        per_layer = d * (h + 2 * kv) * dh + h * dh * d + 2 * d  # attn + norms
+        if self.n_experts:
+            per_layer += d * self.n_experts * (1 + 2 * f)  # router + experts
+        else:
+            per_layer += 2 * d * f  # dense FFN
+        return v * d + L * per_layer + d  # embed + layers + final norm
+
+    @property
     def needs_mesh(self) -> bool:
         """True when the concrete mesh is required at trace time: the
         sequence-parallel and pipeline shard_maps, the MoE layer's
@@ -170,6 +182,22 @@ class TransformerConfig:
                     "pipeline parallelism does not compose with ulysses "
                     "attention; use attention='ring' for pp x sp"
                 )
+
+
+# Named model shapes for the runtime's [model] TOML section. One
+# definition shared by the payload pipeline (runtime/workload.py), the
+# bench, and the driver entry (__graft_entry__.FLAGSHIP): the shape every
+# performance number describes must be the shape the product path trains
+# and serves. "probe" is the machinery-verification default (deliberately
+# tiny); "flagship" is the 41.6M-param bench model. Only shape fields —
+# everything execution-related (attention, remat, pipeline, max_seq)
+# stays derived from the mesh and the [payload] knobs.
+PRESETS: dict[str, dict] = {
+    "probe": dict(vocab=512, d_model=128, n_heads=4, n_kv_heads=0,
+                  n_layers=2, d_ff=512),
+    "flagship": dict(vocab=32000, d_model=512, n_heads=8, n_kv_heads=0,
+                     n_layers=8, d_ff=2048),
+}
 
 
 def init_params(key, cfg: TransformerConfig) -> dict:

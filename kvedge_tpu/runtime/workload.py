@@ -21,7 +21,10 @@ import time
 from kvedge_tpu.config.runtime_config import RuntimeConfig
 from kvedge_tpu.runtime.devicecheck import DeviceCheckResult, run_device_check
 
-# Deliberately tiny: the probe verifies machinery, not throughput.
+# Deliberately tiny: the probe verifies machinery, not throughput. The
+# shape itself is models/transformer.py PRESETS["probe"] — the same table
+# the [model] TOML section resolves against — so the probes and an
+# unconfigured payload can never drift apart.
 PROBE_VOCAB = 512
 PROBE_D_MODEL = 128
 PROBE_LAYERS = 2
@@ -34,25 +37,35 @@ class MeshConfigError(ValueError):
 
 
 def derive_model_config(cfg: RuntimeConfig, *, seq: int):
-    """(TransformerConfig, mesh) for a payload, derived from the mesh.
+    """(TransformerConfig, mesh) for a payload: ``[model]`` x the mesh.
 
-    One derivation shared by the transformer-probe, ``train``, and
-    ``serve`` payloads, so every mesh family the probe exercises is a
-    mesh family training (and checkpoint-compatible serving) supports:
+    One derivation shared by the transformer-probe, ``train``, ``eval``,
+    and ``serve`` payloads, so every mesh family the probe exercises is a
+    mesh family training (and checkpoint-compatible serving) supports.
+
+    The architecture comes from the ``[model]`` TOML section: a preset
+    ("probe" by default, "flagship" for the bench model —
+    models/transformer.py PRESETS) overridden by any explicitly-set
+    field. The mesh then constrains execution:
 
     * ``seq`` axis -> sequence-parallel attention (ring by default, or
-      the strategy named by ``[payload] attention``; ulysses rounds the
-      head count up to a multiple of the axis);
+      the strategy named by ``[payload] attention``);
     * ``expert`` axis -> mixture-of-experts FFN sharded over it;
-    * ``stage`` axis -> pipelined layer stack (one layer per stage when
-      the default depth doesn't divide); composes with ``model``,
+    * ``stage`` axis -> pipelined layer stack; composes with ``model``,
       ``expert``, and ``seq`` (ring only — the seq axis joins the
       pipeline's manual axes; ulysses is refused);
     * ``model`` axis -> Megatron tensor parallelism (annotation-only).
 
-    Raises :class:`MeshConfigError` for un-runnable combinations.
+    Merge discipline: preset-derived values ADAPT to the mesh (head
+    count rounds up for ulysses, depth rounds up to a stage multiple,
+    expert count follows the expert axis) — the same templated config
+    must boot across deployment sizes. Explicitly-set ``[model]`` values
+    are authoritative: a mesh they cannot run on raises
+    :class:`MeshConfigError`, never a silent adjustment — the operator
+    asked for a specific architecture and must get exactly it or a
+    clear refusal.
     """
-    from kvedge_tpu.models import TransformerConfig
+    from kvedge_tpu.models import PRESETS, TransformerConfig
     from kvedge_tpu.parallel import build_mesh
 
     mesh = build_mesh(cfg.mesh)
@@ -80,13 +93,39 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
             f"[payload] attention = {attention!r} is sequence-parallel "
             "and needs a 'seq' axis in the mesh"
         )
-    n_heads = max(4, model_axis)
-    if attention == "ulysses" and n_heads % (sp * model_axis):
+    spec = cfg.model
+    base = PRESETS[spec.preset or "probe"]
+    n_heads = spec.n_heads or max(base["n_heads"], model_axis)
+    group = sp * model_axis
+    if attention == "ulysses" and n_heads % group:
         # Ulysses scatters each model shard's heads over the seq axis:
-        # round up to the next multiple of sp x tp.
-        group = sp * model_axis
-        n_heads = group * -(-n_heads // group)
-    n_experts = axis_sizes.get("expert", 1)
+        # heads must divide by sp x tp (parallel/ulysses.py).
+        if spec.n_heads:
+            raise MeshConfigError(
+                f"[model] n_heads = {spec.n_heads} cannot run ulysses "
+                f"attention on this mesh: the head count must divide by "
+                f"seq x model = {group}"
+            )
+        n_heads = group * -(-n_heads // group)  # round up, preset-derived
+    n_experts_axis = axis_sizes.get("expert", 1)
+    if spec.experts:
+        n_experts = spec.experts
+        if n_experts % n_experts_axis:
+            raise MeshConfigError(
+                f"[model] experts = {n_experts} must divide by the "
+                f"mesh's expert axis ({n_experts_axis}) — each device "
+                "holds E/ep whole experts (parallel/sharding.py)"
+            )
+    else:
+        n_experts = n_experts_axis if n_experts_axis > 1 else 0
+    if not n_experts and (spec.expert_top_k or spec.expert_capacity_factor):
+        # The authoritative-override contract cuts both ways: MoE knobs
+        # on a model that resolved dense would be silently dead config.
+        raise MeshConfigError(
+            "[model] expert_top_k/expert_capacity_factor are set but the "
+            "model is dense (no [model] experts and no 'expert' mesh "
+            "axis) — set experts = N or drop the MoE knobs"
+        )
     stages = axis_sizes.get("stage", 1)
     if stages > 1 and sp > 1 and attention == "ulysses":
         # Ring rides the pipeline's manual axes (pp x sp composes);
@@ -96,9 +135,24 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
             "'ulysses' cannot ride the pipeline's shard_map; use "
             "attention = \"ring\" on stage x seq meshes"
         )
-    n_layers = PROBE_LAYERS
+    n_layers = spec.n_layers or base["n_layers"]
     if stages > 1 and n_layers % stages:
-        n_layers = stages  # one layer per stage
+        if spec.n_layers:
+            raise MeshConfigError(
+                f"[model] n_layers = {n_layers} must divide by the "
+                f"mesh's stage axis ({stages}) — each stage holds L/S "
+                "whole layers"
+            )
+        n_layers = stages * -(-n_layers // stages)  # round up
+    top_k = spec.expert_top_k or 1
+    # Default: provably drop-free capacity (factor * top_k >= E): the
+    # same derived config feeds train AND serve, and serving routes
+    # droplessly — a binding training capacity would make POST /generate
+    # silently disagree with the trained model (the
+    # warn_if_train_serve_divergence regime). Operators who accept that
+    # divergence set [model] expert_capacity_factor themselves.
+    capacity = (spec.expert_capacity_factor
+                or max(n_experts, 1) / top_k)
     # pp x tp and pp x ep run fp32: bf16 contractions against
     # auto-partitioned model/expert axes crash XLA's CPU backend (see
     # parallel/pipeline.py), and payloads must be portable across the
@@ -109,25 +163,30 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
              if stages > 1 and (model_axis > 1 or n_experts > 1)
              and jax.default_backend() == "cpu"
              else TransformerConfig.dtype)
-    return TransformerConfig(
-        vocab=PROBE_VOCAB,
-        d_model=PROBE_D_MODEL,
+    tcfg = TransformerConfig(
+        vocab=spec.vocab or base["vocab"],
+        d_model=spec.d_model or base["d_model"],
         n_heads=n_heads,
+        n_kv_heads=spec.n_kv_heads or base["n_kv_heads"],
         n_layers=n_layers,
-        d_ff=4 * PROBE_D_MODEL,
+        d_ff=spec.d_ff or base["d_ff"],
         max_seq=seq,
         dtype=dtype,
         attention=attention,
-        n_experts=n_experts if n_experts > 1 else 0,
-        # Provably drop-free capacity (factor * top_k >= E): the same
-        # derived config feeds train AND serve, and serving routes
-        # droplessly — a binding training capacity would make POST
-        # /generate silently disagree with the trained model (the
-        # warn_if_train_serve_divergence regime, with no TOML knob to
-        # escape it). At payload scale the extra capacity is noise.
-        expert_capacity_factor=float(max(n_experts, 1)),
+        n_experts=n_experts,
+        expert_top_k=top_k,
+        expert_capacity_factor=float(capacity),
         pipeline_stages=stages if stages > 1 else 0,
-    ), mesh
+    )
+    try:
+        # Cross-field architecture errors (d_model % n_heads, GQA head
+        # divisibility, top_k vs experts) surface as the same clear
+        # config-refusal every other bad combination gets.
+        tcfg.validate()
+    except ValueError as e:
+        raise MeshConfigError(f"[model] configuration is invalid: {e}") \
+            from e
+    return tcfg, mesh
 
 
 def run_transformer_probe(cfg: RuntimeConfig) -> DeviceCheckResult:

@@ -44,16 +44,18 @@ def test_gqa_shrinks_kv_cache_not_flops_much():
 
 
 def test_paged_decode_bench_runs_and_counts_tokens():
-    """The paged-decode window (VERDICT r2 #5) runs on the CPU backend
-    and reports slot-weighted throughput: tokens/s == slots * steps/s."""
+    """The paged-decode window (VERDICT r2 #5, windowed per r3 #2) runs
+    on the CPU backend and reports slot-weighted throughput:
+    tokens/s == slots * steps/s — for both the windowed production path
+    and the per-step host-loop comparison number."""
     from bench import measure_paged_decode
 
     small = dataclasses.replace(
         FLAGSHIP, d_model=64, n_layers=2, d_ff=128, vocab=256,
         max_seq=64, n_heads=4, n_kv_heads=2,
     )
-    tps, sps = measure_paged_decode(
+    tps, sps, host_sps = measure_paged_decode(
         small, slots=3, prompt_len=8, n_new=10, page_size=4
     )
-    assert tps > 0 and sps > 0
+    assert tps > 0 and sps > 0 and host_sps > 0
     assert abs(tps - 3 * sps) < 1e-6

@@ -50,5 +50,7 @@ def test_cast_covers_the_end_to_end_story():
         "train payload ok",             # real resumable training ran
         "restored_step=4",              # serve restored the checkpoint
         "same tokens: True",            # speculative decode is exact
+        '[model] preset = "flagship"',  # operator-sized payload model
+        "41,558,528 params",            # ...at the bench shape, for real
     ):
         assert landmark in transcript, f"missing landmark: {landmark!r}"

@@ -83,6 +83,39 @@ def test_serving_pool_knobs_round_trip_and_validate():
             RuntimeConfig.parse(f"[payload]\n{bad}\n")
 
 
+def test_model_section_parses_and_round_trips():
+    cfg = RuntimeConfig.parse(
+        "[model]\npreset = \"flagship\"\nn_kv_heads = 2\nexperts = 4\n"
+        "expert_top_k = 2\nexpert_capacity_factor = 1.5\n"
+    )
+    assert cfg.model.preset == "flagship"
+    assert cfg.model.n_kv_heads == 2
+    assert cfg.model.experts == 4
+    assert cfg.model.expert_top_k == 2
+    assert cfg.model.expert_capacity_factor == 1.5
+    assert cfg.model.vocab == 0  # unset = from the preset
+    again = RuntimeConfig.parse(cfg.to_toml())
+    assert again.model == cfg.model
+
+
+def test_model_section_defaults_empty():
+    cfg = RuntimeConfig.parse("")
+    assert cfg.model.preset == ""
+    assert cfg.model.d_model == 0
+
+
+def test_model_section_validation():
+    for bad in (
+        "[model]\npreset = 'gpt5'\n",
+        "[model]\nd_model = -1\n",
+        "[model]\nn_heads = \"many\"\n",
+        "[model]\nexpert_top_k = 3\n",
+        "[model]\nexpert_capacity_factor = -0.5\n",
+    ):
+        with pytest.raises(RuntimeConfigError):
+            RuntimeConfig.parse(bad)
+
+
 def test_mesh_resolution():
     spec = MeshSpec(axes=(("data", 0), ("model", 4)))
     assert spec.resolved_shape(8) == (2, 4)

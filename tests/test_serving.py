@@ -108,6 +108,65 @@ def test_mid_stream_admission_does_not_perturb_in_flight(params):
         server.close()
 
 
+def test_request_admitted_mid_window_matches_generate(params):
+    """The device-side decode window (kvcache.step_window) must re-sync
+    with admission between windows: a request submitted while another is
+    mid-decode (windows running — proven by consuming streamed tokens
+    first) joins the batch and BOTH results equal their own contiguous
+    decodes."""
+    server = PagedGenerationServer(params, CFG, slots=2, pages=24)
+    try:
+        src = server.submit_stream([3, 1, 4, 1, 5], n_new=40)
+        first = [next(src) for _ in range(3)]  # windows are in flight now
+        short = server.submit([2, 7], n_new=5)  # admitted mid-decode
+        rest = list(src)
+        long_ref = reference(params, [3, 1, 4, 1, 5], 40)
+        assert [3, 1, 4, 1, 5] + first + rest == long_ref
+        assert short == reference(params, [2, 7], 5)
+    finally:
+        server.close()
+
+
+def test_window_steps_equal_single_steps():
+    """kvcache.step_window is the SAME program as n repeated step()s:
+    same tokens out, same lengths, same page growth."""
+    from kvedge_tpu.models.kvcache import PagedKVCache
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=16, n_heads=2, n_kv_heads=2, n_layers=2,
+        d_ff=32, max_seq=64,
+    )
+    p = init_params(jax.random.PRNGKey(1), cfg)
+    prompts = {0: [5, 9, 2], 2: [7, 7, 7, 7, 7]}  # slot 1 stays inactive
+
+    def fresh():
+        cache = PagedKVCache(cfg, slots=3, pages=24, page_size=4)
+        pend = np.zeros((3,), np.int32)
+        for slot, prompt in prompts.items():
+            cache.admit(slot, len(prompt))
+            logits = cache.prefill(p, slot, jnp.asarray(prompt, jnp.int32))
+            pend[slot] = int(jnp.argmax(logits))
+        return cache, pend
+
+    n = 7  # crosses a page boundary (page_size=4) inside the window
+    cache_w, pend = fresh()
+    window = np.asarray(cache_w.step_window(p, jnp.asarray(pend), n))
+
+    cache_s, toks = fresh()
+    singles = []
+    for _ in range(n):
+        logits = cache_s.step(p, jnp.asarray(toks))
+        toks = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        singles.append(toks.copy())
+
+    for slot in prompts:
+        assert window[:, slot].tolist() == [s[slot] for s in singles], slot
+    assert cache_w._host_lengths == cache_s._host_lengths
+    assert cache_w.free_pages() == cache_s.free_pages()
+    # Inactive slot untouched either way.
+    assert cache_w._host_lengths[1] == 0
+
+
 def test_slot_reuse_after_release(params):
     server = PagedGenerationServer(params, CFG, slots=1, pages=8)
     try:
@@ -134,13 +193,30 @@ def test_admission_control_rejects_impossible_and_times_out(params):
             # 50 + 14 = 64 positions = 4 pages > the 3-page pool
             server.submit([1] * 50, n_new=14)
         # Occupy the only slot, then a second submit must time out.
+        # The occupier's decode is artificially slowed (the windowed
+        # path finishes a warm 30-token budget in milliseconds — faster
+        # than any competitor timeout, so an unslowed occupier races).
+        import time as time_mod
+
+        real_window = server._cache.step_window
+
+        def slow_window(params_, tokens, n_steps):
+            time_mod.sleep(0.1)
+            return real_window(params_, tokens, n_steps)
+
+        server._cache.step_window = slow_window
         t = threading.Thread(
             target=lambda: server.submit([1, 2, 3], n_new=30)
         )
         t.start()
+        deadline = time_mod.monotonic() + 30
+        while (server.stats()["in_flight"] < 1
+               and time_mod.monotonic() < deadline):
+            time_mod.sleep(0.005)  # occupier must hold the slot first
         with pytest.raises(ServerBusy):
             server.submit([4, 5], n_new=2, timeout=0.2)
         t.join(timeout=300)
+        server._cache.step_window = real_window
     finally:
         server.close()
 

@@ -6,7 +6,13 @@ Driven by tools/record_demo.py for the asciinema cast: actually runs the
 step and a live generation — the round-2 half of the end-to-end story
 (the resilience drill in demo_cluster.py is the round-1 half).
 
-Usage: python tools/demo_train_serve.py <corpus.kvfeed>
+With ``--flagship`` the run sizes the payload through the ``[model]``
+TOML section instead of the probe default: the 41.6M-param flagship —
+the exact shape bench.py reports numbers for — trains, checkpoints, and
+serves through the same product path, on whatever accelerator is
+visible (the committed cast records a real TPU v5e run).
+
+Usage: python tools/demo_train_serve.py <corpus.kvfeed> [--flagship]
 """
 
 from __future__ import annotations
@@ -20,10 +26,13 @@ sys.path.insert(0, REPO)
 
 
 def main() -> int:
-    if len(sys.argv) != 2:
-        print("Usage: python tools/demo_train_serve.py <corpus.kvfeed>")
+    args = [a for a in sys.argv[1:] if a != "--flagship"]
+    flagship = "--flagship" in sys.argv[1:]
+    if len(args) != 1:
+        print("Usage: python tools/demo_train_serve.py <corpus.kvfeed> "
+              "[--flagship]")
         return 1
-    corpus = sys.argv[1]
+    corpus = args[0]
     # The cast is a COMMITTED artifact: library warnings (e.g. orbax's
     # restore-topology UserWarning, which embeds the recording machine's
     # site-packages path) would bake environment-specific noise into it
@@ -31,28 +40,38 @@ def main() -> int:
     import warnings
 
     warnings.simplefilter("ignore")
-    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.config.runtime_config import ModelSpec, RuntimeConfig
     from kvedge_tpu.runtime.workload import (
         run_serve_payload,
         run_train_payload,
+        train_model_config,
     )
 
     state_dir = os.path.join(os.path.dirname(os.path.abspath(corpus)),
-                             "state")
+                             "state" + ("-flagship" if flagship else ""))
+    import jax
+
+    platform = jax.default_backend() if flagship else "cpu"
     base = dataclasses.replace(
         RuntimeConfig(),
         name="edge-tpu-demo",
         state_dir=state_dir,
-        expected_platform="cpu",
+        expected_platform=platform,
         status_port=0,
         status_bind="127.0.0.1",
+        model=ModelSpec(preset="flagship" if flagship else ""),
         train_corpus=os.path.abspath(corpus),
         train_steps=4,
         train_batch=8,
-        train_seq=16,
+        train_seq=16 if not flagship else 64,
         train_checkpoint_every=2,
     )
 
+    if flagship:
+        tcfg, _ = train_model_config(base)
+        print(f"[model] preset = \"flagship\": {tcfg.param_count:,} params "
+              f"(d_model={tcfg.d_model}, layers={tcfg.n_layers}, "
+              f"vocab={tcfg.vocab}) on platform={platform}")
     print("training 4 steps (checkpoint every 2) through the state volume...")
     result = run_train_payload(dataclasses.replace(base, payload="train"))
     if not result.ok:

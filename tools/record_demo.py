@@ -85,8 +85,15 @@ class Cast:
                 fh.write(json.dumps(list(ev)) + "\n")
 
 
-def run(cmd: list[str], cwd: str) -> str:
-    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+def run(cmd: list[str], cwd: str, *, real_device: bool = False) -> str:
+    # Scenes run on the virtual CPU platform for speed and determinism —
+    # except the flagship scene, which exists precisely to record the
+    # product path on the real accelerator.
+    env = dict(os.environ, PYTHONPATH=REPO)
+    if not real_device:
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        env.pop("JAX_PLATFORMS", None)
     proc = subprocess.run(cmd, cwd=cwd, env=env, text=True,
                           capture_output=True)
     if proc.returncode != 0:
@@ -106,7 +113,7 @@ def main() -> int:
         fh.write(CONFIG_TOML)
 
     python = sys.executable
-    steps: list[tuple[str, list[str]]] = [
+    steps: list[tuple] = [
         ("python -m kvedge_tpu version",
          [python, "-m", "kvedge_tpu", "version"]),
         ("python -m kvedge_tpu corpus --out corpus.kvfeed --random 4000  "
@@ -132,14 +139,19 @@ def main() -> int:
          "# train -> checkpoint -> serve, one state volume",
          [python, os.path.join(REPO, "tools", "demo_train_serve.py"),
           "corpus.kvfeed"]),
+        ("python tools/demo_train_serve.py corpus.kvfeed --flagship  "
+         "# the 41.6M-param bench model through the SAME product path",
+         [python, os.path.join(REPO, "tools", "demo_train_serve.py"),
+          "corpus.kvfeed", "--flagship"], True),
         ("python -m kvedge_tpu notes",
          [python, "-m", "kvedge_tpu", "notes"]),
     ]
 
-    for shown, cmd in steps:
+    for shown, cmd, *flags in steps:
         cast.prompt()
         cast.type_command(shown)
-        cast.command_output(run(cmd, workdir))
+        cast.command_output(run(cmd, workdir,
+                                real_device=bool(flags and flags[0])))
     cast.prompt()
     cast.out("\r\n", dt=1.2)
 
