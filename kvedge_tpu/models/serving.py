@@ -43,6 +43,10 @@ class ServerClosed(RuntimeError):
     """The server was shut down."""
 
 
+class RequestCancelled(RuntimeError):
+    """The request was cancelled (consumer disconnect / explicit)."""
+
+
 @dataclasses.dataclass
 class _Request:
     prompt: list[int]
@@ -60,6 +64,9 @@ class _Request:
     # Set for streaming requests: every generated token is put here as it
     # lands, then _STREAM_DONE (or the failing exception).
     stream: "queue.SimpleQueue | None" = None
+    # Cancellation request (consumer gone / explicit): honored at the
+    # next loop iteration — the step/window in flight completes first.
+    cancelled: bool = False
 
     def pick(self, logits_row, step: int) -> int:
         """Next token from a [V] logits row, greedy or sampled. Used at
@@ -76,6 +83,39 @@ class _Request:
         return int(sample_token(
             logits_row[None], keys, temperature, top_p
         )[0])
+
+
+class StreamHandle:
+    """Iterator over a streaming request's tokens + cancellation.
+
+    Iteration semantics match the old generator exactly (tests and the
+    HTTP layer consume it with ``next``/``for``); ``cancel()`` is the
+    new client-disconnect hook — it frees the request's slot and pages
+    at the next step/window boundary instead of decoding out the
+    reserved budget.
+    """
+
+    def __init__(self, server: "PagedGenerationServer", req: _Request):
+        self._server = server
+        self._req = req
+        self._produced = 0
+
+    def __iter__(self) -> "StreamHandle":
+        return self
+
+    def __next__(self) -> int:
+        if self._produced >= self._req.n_new:
+            raise StopIteration
+        item = self._req.stream.get()
+        if item is _STREAM_DONE:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        self._produced += 1
+        return item
+
+    def cancel(self) -> None:
+        self._server.cancel(self._req)
 
 
 class PagedGenerationServer:
@@ -108,6 +148,7 @@ class PagedGenerationServer:
         self._active: dict[int, _Request] = {}
         self._free_slots = list(range(slots))[::-1]
         self._closed = False
+        self._draining = False
         self._thread = threading.Thread(
             target=self._loop, name="kvedge-paged-serve", daemon=True
         )
@@ -135,25 +176,31 @@ class PagedGenerationServer:
 
     def submit_stream(self, prompt: list[int], n_new: int,
                       timeout: float = 120.0,
-                      sampling: tuple | None = None):
-        """Streaming generate: yields each generated token as it lands.
+                      sampling: tuple | None = None) -> "StreamHandle":
+        """Streaming generate: an iterator yielding each generated token
+        as it lands, with a ``cancel()`` method.
 
-        Same admission/sampling semantics as :meth:`submit`; the request
-        decodes to completion even if the consumer stops iterating early
-        (its budget was reserved at admission — a disconnecting client
-        does not perturb co-tenants). A mid-stream failure raises from
-        the generator after the tokens already produced.
+        Same admission/sampling semantics as :meth:`submit`. A consumer
+        that merely stops iterating leaves the request decoding out its
+        reserved budget (co-tenants are never perturbed); a consumer
+        that KNOWS the client is gone calls ``cancel()`` and the request
+        releases its slot and pages at the next step/window boundary. A
+        mid-stream failure raises from the iterator after the tokens
+        already produced.
         """
         req = self._start(prompt, n_new, timeout, sampling, stream=True)
-        produced = 0
-        while produced < n_new:
-            item = req.stream.get()
-            if item is _STREAM_DONE:
-                break
-            if isinstance(item, Exception):
-                raise item
-            produced += 1
-            yield item
+        return StreamHandle(self, req)
+
+    def cancel(self, req: _Request) -> None:
+        """Ask the decode loop to drop a request at the next boundary.
+
+        Idempotent, and a no-op for a request that already finished. The
+        waiter (blocked ``submit`` / stream consumer) gets
+        :class:`RequestCancelled`.
+        """
+        with self._work:
+            req.cancelled = True
+            self._work.notify_all()
 
     def _start(self, prompt: list[int], n_new: int, timeout: float,
                sampling: tuple | None, stream: bool) -> _Request:
@@ -185,7 +232,7 @@ class PagedGenerationServer:
         )
         deadline = time.monotonic() + timeout
         with self._work:
-            while (not self._closed
+            while (not self._closed and not self._draining
                    and (not self._free_slots
                         or self._reserved + pages_needed
                         > self._pages_total)):
@@ -196,8 +243,11 @@ class PagedGenerationServer:
                         f"({len(self._active)} requests in flight)"
                     )
                 self._work.wait(timeout=remaining)
-            if self._closed:
-                raise ServerClosed("server is shut down")
+            if self._closed or self._draining:
+                raise ServerClosed(
+                    "server is draining" if self._draining
+                    else "server is shut down"
+                )
             slot = self._free_slots.pop()
             self._reserved += pages_needed
             try:
@@ -216,11 +266,24 @@ class PagedGenerationServer:
             self._work.notify_all()  # wake the decode loop
         return req
 
-    def close(self) -> None:
+    def close(self, drain: bool = False) -> None:
+        """Shut down. Hard close (default) poisons in-flight requests
+        with :class:`ServerClosed`; ``drain=True`` stops admission
+        immediately (new submits fail with ServerClosed) but lets every
+        accepted request decode out its budget before the loop exits —
+        the graceful-restart path. Bounded: an in-flight budget is at
+        most max_seq tokens."""
         with self._work:
-            self._closed = True
+            if drain:
+                self._draining = True
+            else:
+                self._closed = True
             self._work.notify_all()
-        self._thread.join(timeout=30)
+        self._thread.join(timeout=600 if drain else 30)
+        if drain:
+            with self._work:
+                self._closed = True
+                self._work.notify_all()
 
     def stats(self) -> dict:
         with self._lock:
@@ -321,8 +384,11 @@ class PagedGenerationServer:
 
         while True:
             with self._work:
-                while not self._active and not self._closed:
+                while (not self._active and not self._closed
+                       and not self._draining):
                     self._work.wait()
+                if self._draining and not self._active:
+                    return  # drained: every accepted request finished
                 if self._closed:
                     for req in self._active.values():
                         req.error = ServerClosed("server shut down mid-"
@@ -333,6 +399,23 @@ class PagedGenerationServer:
                     self._active.clear()
                     return
                 try:
+                    # Cancelled requests leave at this boundary: slot and
+                    # pages return to the pool, the waiter (if any) gets
+                    # RequestCancelled. Before the finish-check so a
+                    # cancel that raced budget completion still wins —
+                    # the consumer is gone either way.
+                    for slot in list(self._active):
+                        req = self._active[slot]
+                        if not req.cancelled:
+                            continue
+                        del self._active[slot]
+                        self._release_locked(slot, self._pages_for(req))
+                        req.error = RequestCancelled(
+                            "request cancelled mid-decode"
+                        )
+                        if req.stream is not None:
+                            req.stream.put(req.error)
+                        req.done.set()
                     # A request whose pending token completes its budget
                     # needs no step at all (the token is already known) —
                     # finish it before the batch, the same discipline as

@@ -166,22 +166,12 @@ class TransformerConfig:
             raise ValueError(
                 "pipeline_microbatches must be >= 0 (0 = one per stage)"
             )
-        if self.pipeline_stages > 1:
-            if self.n_layers % self.pipeline_stages:
-                raise ValueError(
-                    f"n_layers {self.n_layers} must divide by "
-                    f"pipeline_stages {self.pipeline_stages}"
-                )
-            if self.attention == "ulysses":
-                # Ring composes (the seq axis joins the pipeline's
-                # manual axes and the per-device fold runs directly);
-                # ulysses does not yet — its all_to_all re-shard assumes
-                # it owns the whole [B, T, H] layout, which the
-                # stage-sharded microbatch schedule breaks up.
-                raise ValueError(
-                    "pipeline parallelism does not compose with ulysses "
-                    "attention; use attention='ring' for pp x sp"
-                )
+        if (self.pipeline_stages > 1
+                and self.n_layers % self.pipeline_stages):
+            raise ValueError(
+                f"n_layers {self.n_layers} must divide by "
+                f"pipeline_stages {self.pipeline_stages}"
+            )
 
 
 # Named model shapes for the runtime's [model] TOML section. One
@@ -366,6 +356,19 @@ def _layer(cfg: TransformerConfig, x, layer_params, mesh=None,
             q, k, v, axis_name=seq_manual[0], sp=seq_manual[1]
         )
         attended = attended.reshape(batch, seq, h * dh)
+    elif seq_manual is not None and cfg.attention == "ulysses":
+        # Same move that converted ring x stage in round 3: the
+        # per-device body runs directly inside the enclosing manual
+        # region — lax.all_to_all resolves against a manual axis exactly
+        # like ppermute does, so the head scatter/gather needs no nested
+        # shard_map. A 'model' axis stays automatic out here too: the
+        # all_to_all splits each model shard's local heads over the seq
+        # axis (n_heads % (sp*tp), enforced by ulysses_attention's
+        # non-pipeline twin and derive_model_config).
+        from kvedge_tpu.parallel.ulysses import _ulysses_local
+
+        attended = _ulysses_local(q, k, v, axis_name=seq_manual[0])
+        attended = attended.reshape(batch, seq, h * dh)
     elif cfg.attention in ("ring", "ulysses"):
         if mesh is None:
             raise ValueError(
@@ -472,11 +475,15 @@ def forward_hidden(params: dict, tokens, cfg: TransformerConfig,
         # pipeline's shard_map; constrain_moe=False because an activation
         # NamedSharding cannot be expressed in that partial-manual
         # context — expert placement propagates from the stacked expert
-        # weights' own sharding instead. A ``seq`` axis (ring attention)
-        # joins the pipeline's manual axes: the layer body runs seq-local
-        # and calls the ring's per-device fold directly (pp x sp).
+        # weights' own sharding instead. A ``seq`` axis joins the
+        # pipeline's manual axes: the layer body runs seq-local and
+        # calls its strategy's per-device body directly — the ring's
+        # ppermute fold or ulysses' all_to_all scatter both resolve
+        # against the enclosing manual axis (pp x sp).
         sp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 0)
-        seq_manual = ("seq", sp) if cfg.attention == "ring" and sp else None
+        seq_manual = (("seq", sp)
+                      if cfg.attention in ("ring", "ulysses") and sp
+                      else None)
         x, aux = pipeline_layers(
             x, stacked,
             lambda carry, lp: _layer(cfg, carry, lp, mesh,

@@ -45,10 +45,11 @@ per microbatch (ceil(k*mb_tokens*factor/E) slots per microbatch rather
 than one batch-wide pool), and the router's load-balancing statistics
 are computed per microbatch and averaged — fill/drain steps, which
 compute on garbage, are masked out of that average (see ``step_fn``).
-Ring attention composes too (``seq_axis``): the seq axis joins the
-manual set and the layer body calls the ring's per-device fold directly
-— see :func:`pipeline_layers`. Ulysses is still rejected (its
-all_to_all re-shard assumes it owns the whole layout).
+Sequence parallelism composes too (``seq_axis``): the seq axis joins
+the manual set and the layer body calls its strategy's per-device body
+directly — the ring's ppermute fold or ulysses' all_to_all head
+scatter; both collectives resolve against the enclosing manual axis —
+see :func:`pipeline_layers`.
 """
 
 from __future__ import annotations

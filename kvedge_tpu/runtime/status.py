@@ -317,7 +317,11 @@ class StatusServer:
                         )
                         self.wfile.flush()
                 except BrokenPipeError:
-                    pass  # client went away; the request runs out server-side
+                    # Client went away: close the stream so the serving
+                    # layer cancels its rows at the next decode boundary
+                    # (slots/pages free immediately instead of decoding
+                    # out the reserved budgets — models/serving.py).
+                    stream.close()
                 except Exception as e:
                     doc = {"error": repr(e)}
                     # Multi-row streams attribute the failing row

@@ -52,8 +52,9 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
       the strategy named by ``[payload] attention``);
     * ``expert`` axis -> mixture-of-experts FFN sharded over it;
     * ``stage`` axis -> pipelined layer stack; composes with ``model``,
-      ``expert``, and ``seq`` (ring only — the seq axis joins the
-      pipeline's manual axes; ulysses is refused);
+      ``expert``, and ``seq`` (ring or ulysses — the seq axis joins the
+      pipeline's manual axes and the strategy's per-device body runs
+      inside them);
     * ``model`` axis -> Megatron tensor parallelism (annotation-only).
 
     Merge discipline: preset-derived values ADAPT to the mesh (head
@@ -127,14 +128,6 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
             "axis) — set experts = N or drop the MoE knobs"
         )
     stages = axis_sizes.get("stage", 1)
-    if stages > 1 and sp > 1 and attention == "ulysses":
-        # Ring rides the pipeline's manual axes (pp x sp composes);
-        # ulysses' all_to_all re-shard does not.
-        raise MeshConfigError(
-            "mesh combines 'stage' with 'seq' but [payload] attention = "
-            "'ulysses' cannot ride the pipeline's shard_map; use "
-            "attention = \"ring\" on stage x seq meshes"
-        )
     n_layers = spec.n_layers or base["n_layers"]
     if stages > 1 and n_layers % stages:
         if spec.n_layers:
@@ -596,6 +589,399 @@ def run_eval_payload(cfg: RuntimeConfig) -> DeviceCheckResult:
     )
 
 
+class _ServeCounters:
+    """Request accounting shared by the single-host serve path and the
+    multi-host leader — ONE definition of the ``kvedge_serve_*`` counter
+    vocabulary and of the exception -> outcome-bucket mapping
+    (ValueError -> rejected/400, GenerateUnavailable -> unavailable/503,
+    anything else -> errors/500), so the two paths can never drift on
+    the /metrics contract."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self.data = {
+            "requests_total": 0,
+            "completed_total": 0,
+            "rejected_total": 0,
+            "unavailable_total": 0,
+            "errors_total": 0,
+            "tokens_generated_total": 0,
+            "last_latency_ms": 0.0,
+            "latency_ms_sum": 0.0,
+        }
+
+    def count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.data[key] += n
+
+    def count_outcome(self, exc: Exception) -> None:
+        from kvedge_tpu.runtime.status import GenerateUnavailable
+
+        if isinstance(exc, GenerateUnavailable):
+            self.count("unavailable_total")
+        elif isinstance(exc, ValueError):
+            self.count("rejected_total")
+        else:
+            self.count("errors_total")
+
+    def finish(self, start: float) -> None:
+        import time
+
+        ms = (time.perf_counter() - start) * 1000.0
+        with self._lock:
+            self.data["completed_total"] += 1
+            self.data["last_latency_ms"] = ms
+            self.data["latency_ms_sum"] += ms
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.data)
+
+
+def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
+    """Multi-host ``serve``: leader-serves over the whole slice.
+
+    VERDICT r3 #7. The round-3 refusal existed because N processes would
+    each restore and answer /generate independently — N divergent
+    replicas behind one Service. The leader-serves architecture fixes
+    the coordination problem instead of routing around it:
+
+    * every process restores the checkpoint into the GLOBAL mesh's
+      placements (shared ``checkpoint_dir``, orbax reads each process's
+      shards — exactly like multi-host train/eval);
+    * process 0 (the leader) owns the HTTP endpoint. Followers park in
+      a follow loop on ``multihost_utils.broadcast_one_to_all``;
+    * per request, the leader broadcasts a fixed-shape header (request
+      geometry + sampling controls), then the token rows, and ALL
+      processes execute the same jitted ``generate`` on global arrays —
+      XLA's collectives span the slice exactly as in training;
+    * shutdown broadcasts a stop header; followers exit their loop.
+
+    Requests serialize on the leader (one broadcast conversation at a
+    time), which also guarantees every process issues collectives in
+    the same order — the multi-controller contract. The K8s Service
+    already routes to the leader: the chart's multi-host StatefulSet
+    fronts ordinal 0 (the same pod that owns ``jax.distributed``'s
+    coordinator), so "HTTP hits process 0" is the deployment's natural
+    shape, not an extra router.
+
+    Contiguous backend only: the paged server's admission/decode loop is
+    per-process host state; a cross-host continuous-batching scheduler
+    is a different design (refused loudly below).
+    """
+    import dataclasses
+    import threading
+    import time as time_mod
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kvedge_tpu.models import generate
+    from kvedge_tpu.runtime.status import GenerateUnavailable
+
+    if cfg.payload_serving == "paged":
+        raise MeshConfigError(
+            "multi-host serve supports the contiguous backend only: the "
+            "paged server's admission/decode loop is host-side state on "
+            "one process; drop [payload] serving = \"paged\" or deploy "
+            "serving single-host"
+        )
+    if not cfg.checkpoint_dir:
+        raise MeshConfigError(
+            "multi-host serve needs [runtime] checkpoint_dir on shared "
+            "storage: every process restores the same checkpoint "
+            "(README 'Multi-host')"
+        )
+    restored_step, params = _restore_latest_params(cfg, tcfg, mesh=mesh)
+    leader = jax.process_index() == 0
+    replicated = NamedSharding(mesh, P())
+    max_rows = 4 * cfg.serving_slots
+
+    def bcast(tree):
+        return multihost_utils.broadcast_one_to_all(tree)
+
+    # Header layout (fixed shapes — broadcast requires every process to
+    # present identical structures): ints = [op, rows, prompt_len,
+    # n_new, sampled, seed], floats = [temperature, top_p]. op 0 = stop.
+    def zero_header():
+        return (np.zeros(6, np.int64), np.zeros(2, np.float32))
+
+    # One jitted replicator (not per-request — jit caches on function
+    # identity): reshard any output so every process can read the full
+    # array from its own shards.
+    _replicate = jax.jit(lambda x: x, out_shardings=replicated)
+
+    def run_request(ints, floats, tokens_np):
+        """Executed by EVERY process with identical inputs — the caller
+        must pass the BROADCAST-RETURNED values (broadcast canonicalizes
+        dtypes, e.g. int64 -> int32 under default x64-disabled jax; a
+        leader computing from its pre-broadcast locals could sample with
+        a different seed than the followers)."""
+        rows, n_new = int(ints[1]), int(ints[3])
+        sampled = bool(ints[4])
+        prompt = jax.make_array_from_process_local_data(
+            replicated, tokens_np
+        )
+        sampling = None
+        if sampled:
+            base_key = jax.random.PRNGKey(int(ints[5]))
+            seed_keys = jax.vmap(
+                lambda i: jax.random.fold_in(base_key, i)
+            )(jnp.arange(rows))
+            sampling = (seed_keys, jnp.float32(float(floats[0])),
+                        jnp.float32(float(floats[1])))
+        out = generate(params, prompt, tcfg, n_new=n_new,
+                       sampling=sampling, sampled=sampled)
+        return np.asarray(_replicate(out).addressable_data(0))
+
+    if not leader:
+        def follow():
+            try:
+                while True:
+                    ints, floats = bcast(zero_header())
+                    if int(ints[0]) == 0:
+                        return
+                    rows, plen = int(ints[1]), int(ints[2])
+                    tokens_np = bcast(np.zeros((rows, plen), np.int32))
+                    run_request(ints, floats, tokens_np)
+            except Exception as e:  # pragma: no cover - slice-fatal
+                print(f"[kvedge-serve] follower loop died: {e!r}",
+                      flush=True)
+
+        thread = threading.Thread(target=follow,
+                                  name="kvedge-serve-follow", daemon=True)
+        thread.start()
+
+        # This pod's own /generate answers 503 pointing at the leader;
+        # its real job is the follow loop above. join() lets callers
+        # (tests, an orderly pod shutdown) wait for the leader's stop
+        # broadcast before exiting — killing the process mid-collective
+        # would wedge the slice.
+        def follower_fn(doc: dict) -> dict:
+            raise GenerateUnavailable(
+                f"this pod is follower process {jax.process_index()}; "
+                "generation is served by the leader (process 0 — the "
+                "Service routes to ordinal 0)"
+            )
+
+        follower_fn.stats = lambda: {
+            "backend": "multihost-follower",
+            "processes": jax.process_count(),
+        }
+        follower_fn.close = lambda drain=False: None
+        follower_fn.join = thread.join
+        return dataclasses.replace(
+            base, probe_ms=0.0, probe_checksum=0.0,
+        ), follower_fn
+
+    lock = threading.Lock()
+    stopped = False
+
+    def _serve(doc: dict) -> dict:
+        tokens, n_new, temperature, top_p, seed, stream, spec = (
+            _parse_generate_request(doc, tcfg, max_rows=max_rows,
+                                    paged=False)
+        )
+        if spec:
+            raise ValueError(
+                "'speculative' is not supported on a multi-host serve "
+                "deployment (single-host contiguous only)"
+            )
+        if not -2 ** 31 <= seed < 2 ** 31:
+            # The broadcast canonicalizes the header to int32 (default
+            # x64-disabled jax); refuse rather than silently truncate.
+            raise ValueError("'seed' must fit in int32")
+        arr = np.asarray(tokens, np.int32) % tcfg.vocab
+        sampled = temperature > 0.0
+        with lock:
+            if stopped:
+                raise GenerateUnavailable("server is shut down")
+            ints = np.array(
+                [1, arr.shape[0], arr.shape[1], n_new,
+                 1 if sampled else 0, seed], np.int64,
+            )
+            floats = np.array([temperature, top_p], np.float32)
+            # The leader consumes the broadcast RESULTS, exactly like the
+            # followers — see run_request's dtype-canonicalization note.
+            ints, floats = bcast((ints, floats))
+            arr = bcast(arr)
+            out = run_request(ints, floats, arr)
+        return {
+            "tokens": [[int(t) for t in row] for row in out.tolist()],
+            "n_new": n_new,
+            "restored_step": restored_step,
+        }
+
+    counters = _ServeCounters()
+
+    def serve_fn(doc: dict) -> dict:
+        counters.count("requests_total")
+        start = time_mod.perf_counter()
+        try:
+            result = _serve(doc)
+        except Exception as e:
+            counters.count_outcome(e)
+            raise
+        counters.count("tokens_generated_total",
+                       result["n_new"] * len(result["tokens"]))
+        counters.finish(start)
+        return result
+
+    def serve_stats() -> dict:
+        out = counters.snapshot()
+        out["backend"] = "multihost-contiguous"
+        out["processes"] = jax.process_count()
+        return out
+
+    serve_fn.stats = serve_stats
+
+    def close(drain: bool = False) -> None:
+        nonlocal stopped
+        with lock:
+            if stopped:
+                return
+            stopped = True
+            bcast(zero_header())  # op 0: followers exit their loop
+
+    serve_fn.close = close
+
+    # Boot self-check through the REAL broadcast path: proves the whole
+    # slice answers before the endpoint goes live (followers are already
+    # in their loop — the first collective is the sync point).
+    probe_prompt = list(range(1, min(4, tcfg.max_seq - 1) + 1))
+    probe_new = min(2, tcfg.max_seq - len(probe_prompt))
+    start = time_mod.perf_counter()
+    probe = _serve({"tokens": [probe_prompt], "n_new": probe_new})
+    elapsed_ms = (time_mod.perf_counter() - start) * 1000.0
+    return dataclasses.replace(
+        base, probe_ms=elapsed_ms,
+        probe_checksum=float(sum(probe["tokens"][0])),
+    ), serve_fn
+
+
+def _parse_generate_request(doc: dict, tcfg, *, max_rows: int,
+                            paged: bool):
+    """Validate a ``POST /generate`` body. ONE definition shared by the
+    single-host serve path and the multi-host leader (the two must never
+    drift on what a well-formed request is). Returns
+    ``(tokens, n_new, temperature, top_p, seed, stream, spec)``; raises
+    ``ValueError`` (the HTTP layer's 400) for anything malformed.
+    """
+    tokens = doc.get("tokens")
+    if (not isinstance(tokens, list) or not tokens
+            or not all(isinstance(r, list) and r for r in tokens)):
+        raise ValueError(
+            "body must carry 'tokens': a non-empty list of "
+            "non-empty token-id rows"
+        )
+    if len({len(r) for r in tokens}) != 1:
+        raise ValueError("all token rows must have equal length")
+    if len(tokens) > max_rows:
+        # Both backends need a ceiling: the paged path fans rows out to
+        # the bounded worker pool (a burst of thousands of rows would
+        # queue, not thread-storm, but the client deserves a clear
+        # refusal over an hour-long queue), and the contiguous path
+        # jit-compiles one program per batch size (an unbounded compile
+        # surface).
+        raise ValueError(
+            f"request carries {len(tokens)} token rows > the "
+            f"runtime's ceiling of {max_rows} (4 x "
+            "serving_slots); split the request"
+        )
+    try:
+        n_new = int(doc.get("n_new", 16))
+    except (TypeError, ValueError):
+        raise ValueError("'n_new' must be an integer") from None
+    if not 1 <= n_new <= tcfg.max_seq:
+        raise ValueError(
+            f"'n_new' must be in [1, {tcfg.max_seq}]"
+        )
+    if len(tokens[0]) + n_new > tcfg.max_seq:
+        raise ValueError(
+            f"prompt ({len(tokens[0])}) + n_new ({n_new}) exceeds "
+            f"the model's max_seq ({tcfg.max_seq})"
+        )
+    if not all(
+        isinstance(t, int) and not isinstance(t, bool)
+        for row in tokens for t in row
+    ):
+        # Explicit check: jnp.asarray would silently TRUNCATE floats
+        # (1.9 -> 1) and decode a different prompt than the client sent.
+        raise ValueError("token rows must contain integers")
+    # Sampling controls: temperature 0 (default) = greedy; > 0 samples
+    # through the shared nucleus filter with the deterministic per-row
+    # key schedule (seed, row, token) — identical across backends.
+    raw_t = doc.get("temperature", 0.0)
+    raw_p = doc.get("top_p", 1.0)
+    raw_seed = doc.get("seed", 0)
+    # Strict types, same discipline as the token check above: bool is an
+    # int subclass (true would silently become 1.0 and switch the client
+    # to sampling), and a float seed would silently truncate to a seed
+    # the client did not send.
+    if (not isinstance(raw_t, (int, float))
+            or isinstance(raw_t, bool)
+            or not isinstance(raw_p, (int, float))
+            or isinstance(raw_p, bool)
+            or not isinstance(raw_seed, int)
+            or isinstance(raw_seed, bool)):
+        raise ValueError(
+            "'temperature'/'top_p' must be numbers and 'seed' "
+            "an integer"
+        )
+    temperature, top_p, seed = float(raw_t), float(raw_p), raw_seed
+    stream = doc.get("stream", False)
+    if not isinstance(stream, bool):
+        raise ValueError("'stream' must be a boolean")
+    if stream and not paged:
+        raise ValueError(
+            "'stream' requires [payload] serving = \"paged\" — "
+            "the contiguous backend decodes the whole request as "
+            "one compiled program, so there is nothing to stream"
+        )
+    if temperature < 0.0:
+        raise ValueError("'temperature' must be >= 0")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError("'top_p' must be in (0, 1]")
+    # Speculative decoding ('speculative': K = draft length): greedy,
+    # single-row, contiguous-backend — a latency lever, token-for-token
+    # identical to plain greedy decode (models/speculative.py).
+    spec = doc.get("speculative", 0)
+    if (not isinstance(spec, int) or isinstance(spec, bool)
+            or not 0 <= spec <= 16):
+        raise ValueError(
+            "'speculative' must be an integer draft length in "
+            "[0, 16] (0 = off)"
+        )
+    if spec:
+        # Stream check FIRST: on a paged runtime (the only place
+        # 'stream' is legal) the composition error is the clearer
+        # message; after the paged check it would be unreachable.
+        if stream:
+            raise ValueError(
+                "'speculative' does not compose with 'stream'"
+            )
+        if paged:
+            raise ValueError(
+                "'speculative' runs on the contiguous backend; "
+                "this runtime serves [payload] serving = \"paged\""
+            )
+        if len(tokens) != 1:
+            raise ValueError(
+                "'speculative' supports exactly one token row"
+            )
+        if temperature > 0.0:
+            raise ValueError(
+                "'speculative' is greedy-only (temperature 0): "
+                "drafts verify against the argmax"
+            )
+    return tokens, n_new, temperature, top_p, seed, stream, spec
+
+
 def run_serve_payload(cfg: RuntimeConfig):
     """The ``serve`` payload: greedy decode behind ``POST /generate``.
 
@@ -610,9 +996,9 @@ def run_serve_payload(cfg: RuntimeConfig):
     placements (the same partition rules training used), and decode runs
     under jit with those shardings driving XLA's SPMD partitioner — a
     checkpoint that needed the ``model``/``expert`` axes to train serves
-    over them too. Multi-host serve is refused with a clear
-    :class:`MeshConfigError` (each process would independently restore
-    and serve).
+    over them too. On a multi-host slice the payload switches to
+    leader-serves (:func:`_run_multihost_serve`): process 0 owns HTTP
+    and every decode is an SPMD computation the whole slice joins.
 
     Returns ``(DeviceCheckResult, serve_fn | None)``; ``serve_fn(doc)``
     implements the request contract::
@@ -641,18 +1027,11 @@ def run_serve_payload(cfg: RuntimeConfig):
     try:
         tcfg, mesh = train_model_config(cfg)
         if jax.process_count() > 1:
-            # Single-host only, refused loudly: every process of a slice
-            # would independently restore the checkpoint and answer
-            # /generate through its own pod IP — N divergent serving
-            # replicas pretending to be one endpoint. (Training is the
-            # multi-host payload; serving a slice needs a request router
-            # that does not exist yet.)
-            raise MeshConfigError(
-                "multi-host serve is not supported: "
-                f"{jax.process_count()} processes would each restore and "
-                "serve independently; deploy serve as a single-host "
-                "release ([distributed] num_processes = 1)"
-            )
+            # Leader-serves: process 0 owns HTTP; every decode is an
+            # SPMD computation the whole slice joins (see
+            # _run_multihost_serve). Followers return serve_fn=None —
+            # their /generate answers 503 pointing at the leader.
+            return _run_multihost_serve(cfg, base, tcfg, mesh)
         # Placement-aware restore: params land sharded over THIS mesh
         # (model/expert/stage axes), so a checkpoint whose model needed
         # tensor parallelism to fit serves over the same axes — decode
@@ -660,6 +1039,11 @@ def run_serve_payload(cfg: RuntimeConfig):
         # partitioner, exactly like the train step.
         restored_step, params = _restore_latest_params(cfg, tcfg, mesh=mesh)
 
+        # Row ceiling + worker pool sized from the serving knobs: the
+        # serve path must not spawn one thread per row (VERDICT r3 #6 —
+        # a burst of wide requests was an unbounded thread surface).
+        max_rows = 4 * cfg.serving_slots
+        row_pool = None
         paged_server = None
         if cfg.payload_serving == "paged":
             from kvedge_tpu.models.serving import PagedGenerationServer
@@ -676,61 +1060,29 @@ def run_serve_payload(cfg: RuntimeConfig):
                 params, tcfg, slots=slots, pages=pages,
                 page_size=page_size,
             )
+            # One shared pool for row priming AND stream pumping, sized
+            # 2x slots (only `slots` rows decode concurrently; one
+            # primer + one pump each is the useful parallelism). Excess
+            # rows queue here instead of spawning threads; progress is
+            # guaranteed because decode never depends on a pool worker
+            # (tokens buffer in each request's queue regardless).
+            import concurrent.futures
+
+            row_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2 * slots,
+                thread_name_prefix="kvedge-serve-row",
+            )
         lock = threading.Lock()
 
         def _serve(doc: dict) -> dict:
-            tokens = doc.get("tokens")
-            if (not isinstance(tokens, list) or not tokens
-                    or not all(isinstance(r, list) and r for r in tokens)):
-                raise ValueError(
-                    "body must carry 'tokens': a non-empty list of "
-                    "non-empty token-id rows"
+            tokens, n_new, temperature, top_p, seed, stream, spec = (
+                _parse_generate_request(
+                    doc, tcfg, max_rows=max_rows,
+                    paged=paged_server is not None,
                 )
-            if len({len(r) for r in tokens}) != 1:
-                raise ValueError("all token rows must have equal length")
-            try:
-                n_new = int(doc.get("n_new", 16))
-            except (TypeError, ValueError):
-                raise ValueError("'n_new' must be an integer") from None
-            if not 1 <= n_new <= tcfg.max_seq:
-                raise ValueError(
-                    f"'n_new' must be in [1, {tcfg.max_seq}]"
-                )
-            if len(tokens[0]) + n_new > tcfg.max_seq:
-                raise ValueError(
-                    f"prompt ({len(tokens[0])}) + n_new ({n_new}) exceeds "
-                    f"the model's max_seq ({tcfg.max_seq})"
-                )
-            if not all(
-                isinstance(t, int) and not isinstance(t, bool)
-                for row in tokens for t in row
-            ):
-                # Explicit check: jnp.asarray would silently TRUNCATE
-                # floats (1.9 -> 1) and decode a different prompt than
-                # the client sent.
-                raise ValueError("token rows must contain integers")
-            # Sampling controls: temperature 0 (default) = greedy; > 0
-            # samples through the shared nucleus filter with the
-            # deterministic per-row key schedule (seed, row, token) —
-            # identical across the contiguous and paged backends.
-            raw_t = doc.get("temperature", 0.0)
-            raw_p = doc.get("top_p", 1.0)
-            raw_seed = doc.get("seed", 0)
-            # Strict types, same discipline as the token check above:
-            # bool is an int subclass (true would silently become 1.0 and
-            # switch the client to sampling), and a float seed would
-            # silently truncate to a seed the client did not send.
-            if (not isinstance(raw_t, (int, float))
-                    or isinstance(raw_t, bool)
-                    or not isinstance(raw_p, (int, float))
-                    or isinstance(raw_p, bool)
-                    or not isinstance(raw_seed, int)
-                    or isinstance(raw_seed, bool)):
-                raise ValueError(
-                    "'temperature'/'top_p' must be numbers and 'seed' "
-                    "an integer"
-                )
-            temperature, top_p, seed = float(raw_t), float(raw_p), raw_seed
+            )
+            sampled = temperature > 0.0
+            base_key = jax.random.PRNGKey(seed) if sampled else None
 
             def row_sampling(i: int):
                 """Row i's sampling triple — ONE definition of the
@@ -740,55 +1092,6 @@ def run_serve_payload(cfg: RuntimeConfig):
                 return (jax.random.fold_in(base_key, i),
                         jnp.float32(temperature), jnp.float32(top_p))
 
-            stream = doc.get("stream", False)
-            if not isinstance(stream, bool):
-                raise ValueError("'stream' must be a boolean")
-            if stream and paged_server is None:
-                raise ValueError(
-                    "'stream' requires [payload] serving = \"paged\" — "
-                    "the contiguous backend decodes the whole request as "
-                    "one compiled program, so there is nothing to stream"
-                )
-            if temperature < 0.0:
-                raise ValueError("'temperature' must be >= 0")
-            if not 0.0 < top_p <= 1.0:
-                raise ValueError("'top_p' must be in (0, 1]")
-            sampled = temperature > 0.0
-            base_key = jax.random.PRNGKey(seed) if sampled else None
-            # Speculative decoding ('speculative': K = draft length):
-            # greedy, single-row, contiguous-backend — a latency lever,
-            # token-for-token identical to plain greedy decode
-            # (models/speculative.py).
-            spec = doc.get("speculative", 0)
-            if (not isinstance(spec, int) or isinstance(spec, bool)
-                    or not 0 <= spec <= 16):
-                raise ValueError(
-                    "'speculative' must be an integer draft length in "
-                    "[0, 16] (0 = off)"
-                )
-            if spec:
-                # Stream check FIRST: on a paged runtime (the only
-                # place 'stream' is legal) the composition error is the
-                # clearer message; after the paged check it would be
-                # unreachable.
-                if stream:
-                    raise ValueError(
-                        "'speculative' does not compose with 'stream'"
-                    )
-                if paged_server is not None:
-                    raise ValueError(
-                        "'speculative' runs on the contiguous backend; "
-                        "this runtime serves [payload] serving = \"paged\""
-                    )
-                if len(tokens) != 1:
-                    raise ValueError(
-                        "'speculative' supports exactly one token row"
-                    )
-                if sampled:
-                    raise ValueError(
-                        "'speculative' is greedy-only (temperature 0): "
-                        "drafts verify against the argmax"
-                    )
             if paged_server is not None:
                 # Continuous batching: each row is its own request into
                 # the shared page pool, submitted CONCURRENTLY so the
@@ -801,14 +1104,15 @@ def run_serve_payload(cfg: RuntimeConfig):
                 from kvedge_tpu.runtime.status import GenerateUnavailable
 
                 def fan_out_rows(n_rows: int, fn) -> None:
-                    """Run ``fn(i)`` per row in concurrent threads (rows
-                    must submit together to ride the same batched decode
-                    step), then apply the ONE error-priority policy:
-                    real faults surface first (HTTP 500), capacity
-                    conditions become GenerateUnavailable (503). Shared
-                    by the streamed and non-streamed paths so the two
-                    can never map the same server condition to different
-                    statuses."""
+                    """Run ``fn(i)`` per row on the shared bounded pool
+                    (rows must submit together to ride the same batched
+                    decode step; excess rows queue behind the pool's
+                    2 x slots workers), then apply the ONE
+                    error-priority policy: real faults surface first
+                    (HTTP 500), capacity conditions become
+                    GenerateUnavailable (503). Shared by the streamed
+                    and non-streamed paths so the two can never map the
+                    same server condition to different statuses."""
                     errors: list = [None] * n_rows
 
                     def guarded(i):
@@ -817,14 +1121,11 @@ def run_serve_payload(cfg: RuntimeConfig):
                         except Exception as e:
                             errors[i] = e
 
-                    workers = [
-                        threading.Thread(target=guarded, args=(i,))
-                        for i in range(n_rows)
+                    futures = [
+                        row_pool.submit(guarded, i) for i in range(n_rows)
                     ]
-                    for w in workers:
-                        w.start()
-                    for w in workers:
-                        w.join()
+                    for f in futures:
+                        f.result()
                     for e in errors:
                         if e is not None and not isinstance(
                             e, (ServerBusy, ServerClosed)
@@ -844,9 +1145,10 @@ def run_serve_payload(cfg: RuntimeConfig):
                     # (ServerBusy) must surface as a clean 503 status,
                     # which is impossible once streaming has started.
                     # (Rows beyond the slot count admit as earlier rows
-                    # finish; a timeout still 503s cleanly — already-
-                    # admitted rows decode out their reserved budgets,
-                    # which the server supports for abandoned consumers.)
+                    # finish; on a timeout the already-admitted rows are
+                    # CANCELLED so the 503 frees their slots and pages
+                    # at the next decode boundary instead of decoding
+                    # out budgets nobody will read.)
                     sources: list = [None] * len(prompts)
                     firsts: list = [None] * len(prompts)
 
@@ -857,7 +1159,13 @@ def run_serve_payload(cfg: RuntimeConfig):
                         firsts[i] = next(src)
                         sources[i] = src
 
-                    fan_out_rows(len(prompts), prime)
+                    try:
+                        fan_out_rows(len(prompts), prime)
+                    except Exception:
+                        for src in sources:
+                            if src is not None:
+                                src.cancel()
+                        raise
 
                     _ROW_DONE = object()
 
@@ -879,29 +1187,42 @@ def run_serve_payload(cfg: RuntimeConfig):
                             except Exception as e:
                                 out_q.put((i, e))
 
-                        pumps = [
-                            threading.Thread(target=pump, args=(i,),
-                                             daemon=True)
-                            for i in range(len(prompts))
-                        ]
-                        for p in pumps:
-                            p.start()
+                        # Pumps ride the same bounded pool. Rows beyond
+                        # the worker count pump after earlier rows
+                        # finish — their tokens buffer in the server's
+                        # per-request queues meanwhile, so decode never
+                        # stalls on pump scheduling.
+                        for i in range(len(prompts)):
+                            row_pool.submit(pump, i)
                         generated = [[] for _ in prompts]
                         live = len(prompts)
-                        while live:
-                            i, item = out_q.get()
-                            if item is _ROW_DONE:
-                                live -= 1
-                                continue
-                            if isinstance(item, Exception):
-                                # Attribute the failing row: the HTTP
-                                # layer's final {"error": ...} document
-                                # carries it (status.py), so healthy
-                                # rows' truncation is diagnosable.
-                                item.stream_row = i
-                                raise item
-                            generated[i].append(item)
-                            yield {"row": i, "token": item}
+                        try:
+                            while live:
+                                i, item = out_q.get()
+                                if item is _ROW_DONE:
+                                    live -= 1
+                                    continue
+                                if isinstance(item, Exception):
+                                    # Attribute the failing row: the HTTP
+                                    # layer's final {"error": ...} document
+                                    # carries it (status.py), so healthy
+                                    # rows' truncation is diagnosable.
+                                    item.stream_row = i
+                                    raise item
+                                generated[i].append(item)
+                                yield {"row": i, "token": item}
+                        except GeneratorExit:
+                            # The HTTP layer closed us: the client is
+                            # gone. Cancel every row so slots and pages
+                            # free at the next decode boundary instead
+                            # of decoding out the reserved budgets
+                            # (models/serving.py cancel); the pump
+                            # threads unblock on the RequestCancelled
+                            # their streams receive.
+                            for src in sources:
+                                if src is not None:
+                                    src.cancel()
+                            raise
                         yield {
                             "done": True,
                             "tokens": [p + g for p, g
@@ -960,53 +1281,24 @@ def run_serve_payload(cfg: RuntimeConfig):
             }
 
         # Request accounting around _serve: the serving half of the
-        # observability story (/metrics kvedge_serve_* gauges). Counter
-        # buckets mirror the HTTP status classes the handler maps these
-        # exceptions to: rejected = 400, unavailable = 503, errors = 500.
-        from kvedge_tpu.runtime.status import GenerateUnavailable
-
-        stats_lock = threading.Lock()
-        counters = {
-            "requests_total": 0,
-            "completed_total": 0,
-            "rejected_total": 0,
-            "unavailable_total": 0,
-            "errors_total": 0,
-            "tokens_generated_total": 0,
-            "last_latency_ms": 0.0,
-            "latency_ms_sum": 0.0,
-        }
-
-        def _count(key: str, n: int = 1) -> None:
-            with stats_lock:
-                counters[key] += n
-
-        def _finish(start: float) -> None:
-            ms = (time_mod.perf_counter() - start) * 1000.0
-            with stats_lock:
-                counters["completed_total"] += 1
-                counters["last_latency_ms"] = ms
-                counters["latency_ms_sum"] += ms
+        # observability story (/metrics kvedge_serve_* gauges); counter
+        # vocabulary and outcome mapping live in _ServeCounters (shared
+        # with the multi-host leader).
+        counters = _ServeCounters()
 
         def serve_fn(doc: dict) -> dict:
-            _count("requests_total")
+            counters.count("requests_total")
             start = time_mod.perf_counter()
             try:
                 result = _serve(doc)
-            except ValueError:
-                _count("rejected_total")
-                raise
-            except GenerateUnavailable:
-                _count("unavailable_total")
-                raise
-            except Exception:
-                _count("errors_total")
+            except Exception as e:
+                counters.count_outcome(e)
                 raise
             stream = result.get("_stream")
             if stream is None:
-                _count("tokens_generated_total",
-                       result["n_new"] * len(result["tokens"]))
-                _finish(start)
+                counters.count("tokens_generated_total",
+                               result["n_new"] * len(result["tokens"]))
+                counters.finish(start)
                 return result
 
             def counted():
@@ -1021,21 +1313,24 @@ def run_serve_payload(cfg: RuntimeConfig):
                 try:
                     for item in stream:
                         if "token" in item:
-                            _count("tokens_generated_total")
+                            counters.count("tokens_generated_total")
                         yield item
-                except GenerateUnavailable:
-                    _count("unavailable_total")
+                except GeneratorExit:
+                    # Closed by the HTTP layer on client disconnect:
+                    # propagate so the inner generator cancels its rows.
+                    # Still no completion recorded — matching what the
+                    # client observed.
+                    stream.close()
                     raise
-                except Exception:
-                    _count("errors_total")
+                except Exception as e:
+                    counters.count_outcome(e)
                     raise
-                _finish(start)
+                counters.finish(start)
 
             return {**result, "_stream": counted()}
 
         def serve_stats() -> dict:
-            with stats_lock:
-                out = dict(counters)
+            out = counters.snapshot()
             out["backend"] = ("paged" if paged_server is not None
                               else "contiguous")
             if paged_server is not None:
@@ -1064,10 +1359,23 @@ def run_serve_payload(cfg: RuntimeConfig):
         probe = _serve({"tokens": [probe_prompt], "n_new": probe_new})
         elapsed_ms = (time_mod.perf_counter() - start) * 1000.0
         # Teardown path: the paged server owns a decode thread and the
-        # device-side page pool; callers (RuntimeHandle.shutdown, test
-        # fixtures) release them via serve_fn.close().
-        serve_fn.close = (paged_server.close if paged_server is not None
-                          else lambda: None)
+        # device-side page pool, plus the bounded row pool; callers
+        # (RuntimeHandle.shutdown, test fixtures) release them via
+        # serve_fn.close(). drain=True finishes in-flight budgets
+        # before stopping (models/serving.py close semantics).
+        def _close(drain: bool = False) -> None:
+            if paged_server is not None:
+                paged_server.close(drain=drain)
+            if row_pool is not None:
+                # Drain must let QUEUED pumps run: a streamed request
+                # wider than the pool still has rows waiting to pump,
+                # and cancelling them would leave its ndjson merger
+                # blocked on row-done markers that never come. The
+                # pumps finish promptly — the drained server has
+                # already completed (or poisoned) every stream queue.
+                row_pool.shutdown(wait=drain, cancel_futures=not drain)
+
+        serve_fn.close = _close
     except MeshConfigError as e:
         # Raised before any server/device state exists: surface the
         # operator-facing config message, not a wrapped traceback.
@@ -1077,6 +1385,8 @@ def run_serve_payload(cfg: RuntimeConfig):
             try:
                 if paged_server is not None:
                     paged_server.close()
+                if row_pool is not None:
+                    row_pool.shutdown(wait=False, cancel_futures=True)
             except (NameError, UnboundLocalError):
                 pass  # failed before the variable existed
         return dataclasses.replace(

@@ -285,6 +285,141 @@ def _finish(procs, timeout=300):
     ]
 
 
+# ---- Multi-host serving: leader-serves (VERDICT r3 #7) -------------------
+#
+# Two pods train as one slice, then BOTH boot the serve payload against
+# the shared checkpoint: process 0 answers generation (each decode is an
+# SPMD computation the follower joins via the broadcast protocol in
+# workload._run_multihost_serve); the follower's own serve_fn 503s
+# pointing at the leader. The leader's tokens must equal the test
+# process's single-host teacher-forced decode of the same checkpoint.
+
+_SERVE_WORKER = textwrap.dedent("""
+    import dataclasses, json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.parallel.distributed import maybe_initialize
+    from kvedge_tpu.runtime.workload import (
+        run_serve_payload, run_train_payload,
+    )
+
+    cfg = RuntimeConfig.parse(open(os.environ["KVEDGE_SERVE_TOML"]).read())
+    maybe_initialize(cfg.distributed, environ=os.environ,
+                     hostname=os.environ["FAKE_POD_NAME"])
+    tr = run_train_payload(cfg)
+    if not tr.ok:
+        print(f"TRAINFAIL {tr.error!r}", flush=True)
+        sys.exit(1)
+    check, serve_fn = run_serve_payload(
+        dataclasses.replace(cfg, payload="serve")
+    )
+    print(f"SERVE ok={check.ok} err={check.error!r}", flush=True)
+    if not check.ok:
+        sys.exit(1)
+    if jax.process_index() == 0:
+        out = serve_fn({"tokens": [[3, 1, 4]], "n_new": 3})
+        print("TOKENS " + json.dumps(out["tokens"]), flush=True)
+        print(f"STEP {out['restored_step']}", flush=True)
+        print(f"BACKEND {serve_fn.stats()['backend']}", flush=True)
+        serve_fn.close()
+    else:
+        try:
+            serve_fn({"tokens": [[1, 2]], "n_new": 1})
+            print("FOLLOWER-ANSWERED (should have 503d)", flush=True)
+            sys.exit(1)
+        except Exception as e:
+            print(f"FOLLOWER503 {type(e).__name__}", flush=True)
+        serve_fn.join(timeout=240)
+    sys.exit(0)
+""")
+
+
+def test_two_process_leader_serves_slice_trained_checkpoint(tmp_path):
+    import json as json_mod
+    import re
+
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        toml_path = tmp_path / f"serve-{pid}.toml"
+        toml_path.write_text(_train_toml(
+            tmp_path, num_processes=2, steps=4,
+            state_dir=tmp_path / f"pvc-{pid}", port=port,
+        ))
+        env = dict(
+            os.environ,
+            FAKE_POD_NAME=f"kvedge-tpu-runtime-{pid}",
+            KVEDGE_SERVE_TOML=str(toml_path),
+            PYTHONPATH=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        )
+        env.pop("XLA_FLAGS", None)  # 1 CPU device per "pod"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _SERVE_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=tmp_path,
+        ))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"serve worker failed:\n{out}\n{err}"
+        outs.append(out)
+    leader_out = outs[0]
+    tokens = json_mod.loads(
+        re.search(r"TOKENS (.*)", leader_out).group(1)
+    )
+    assert re.search(r"STEP 4", leader_out)
+    assert "BACKEND multihost-contiguous" in leader_out
+    assert any("FOLLOWER503 GenerateUnavailable" in o for o in outs)
+
+    # Reference: the SAME shared checkpoint, restored single-host in this
+    # process, teacher-forced over the leader's prompt.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kvedge_tpu.models import forward, init_params, make_train_step
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+    from kvedge_tpu.runtime.workload import train_model_config
+
+    cfg = RuntimeConfig.parse((tmp_path / "serve-0.toml").read_text())
+    tcfg, _ = train_model_config(
+        RuntimeConfig.from_mapping({
+            "payload": {"seq": cfg.train_seq},
+        })
+    )
+    # The checkpoint was written on a different (2-process) topology:
+    # restore against an abstract target so orbax reshapes rather than
+    # demanding the saving devices.
+    init_opt, _ = make_train_step(tcfg)
+
+    def fresh():
+        p = init_params(jax.random.PRNGKey(0), tcfg)
+        return {"params": p, "opt_state": init_opt(p)}
+
+    dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    abstract = jax.tree_util.tree_map(
+        lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                          sharding=dev),
+        jax.eval_shape(fresh),
+    )
+    with StateCheckpointer(
+        str(tmp_path / "ref-state"), checkpoint_dir=str(cfg.checkpoint_dir)
+    ) as ckpt:
+        step, tree = ckpt.restore_latest(abstract)
+    assert step == 4
+    params = tree["params"]
+    so_far = jnp.asarray([[3, 1, 4]], jnp.int32)
+    for _ in range(3):
+        nxt = jnp.argmax(forward(params, so_far, tcfg)[:, -1], axis=-1)
+        so_far = jnp.concatenate(
+            [so_far, nxt[:, None].astype(jnp.int32)], axis=1
+        )
+    np.testing.assert_array_equal(np.asarray(tokens), np.asarray(so_far))
+
+
 def test_two_process_train_survives_kill_and_matches_single(tmp_path):
     import re
     import signal

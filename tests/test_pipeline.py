@@ -226,15 +226,20 @@ def test_transformer_probe_stage_plus_seq_mesh_runs_ring(tmp_path):
     assert math.isfinite(result.probe_checksum)
 
 
-def test_probe_reports_clear_error_for_stage_plus_seq_ulysses(tmp_path):
-    """Ulysses still cannot ride the pipeline's shard_map — refused with
-    an operator-facing message, never silently mis-sharded."""
+def test_transformer_probe_stage_plus_seq_mesh_runs_ulysses(tmp_path):
+    """VERDICT r3 #4: the ulysses x stage cell is CONVERTED — the same
+    move as ring in round 3 (the per-device body runs inside the
+    pipeline's manual axes; lax.all_to_all resolves against a manual
+    axis exactly like ppermute). Was: a 'cannot ride the shard_map'
+    refusal."""
+    import math
+
     from kvedge_tpu.config.runtime_config import RuntimeConfig
     from kvedge_tpu.runtime.workload import run_transformer_probe
 
     cfg = dataclasses.replace(
         RuntimeConfig(),
-        name="pp-conflict",
+        name="pp-ulysses-probe",
         state_dir=str(tmp_path / "state"),
         expected_platform="cpu",
         status_port=0,
@@ -243,8 +248,8 @@ def test_probe_reports_clear_error_for_stage_plus_seq_ulysses(tmp_path):
         mesh=MeshSpec(axes=(("seq", 2), ("stage", 4))),
     )
     result = run_transformer_probe(cfg)
-    assert not result.ok
-    assert "ulysses" in result.error and "ring" in result.error
+    assert result.ok, result.error
+    assert math.isfinite(result.probe_checksum)
 
 
 def test_transformer_probe_pipeline_on_stage_mesh(tmp_path):
@@ -361,6 +366,74 @@ def test_pipeline_ring_train_step_runs_and_learns():
         mesh, init_params(jax.random.PRNGKey(0), RING_PP_CFG)
     )
     init_opt, train_step = make_train_step(RING_PP_CFG, mesh=mesh)
+    opt_state = init_opt(params)
+    batch = shard_batch(mesh, jax.random.randint(
+        jax.random.PRNGKey(3), (8, 33), 0, 128
+    ))
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+# ---- Pipeline x ulysses (VERDICT r3 #4: the last strategy cell) ----------
+#
+# Identical harness to the ring suite above: the seq axis joins the
+# pipeline's manual axes and the layer body calls _ulysses_local
+# directly — its lax.all_to_all head scatter resolves against the
+# enclosing manual axis just like the ring's ppermute. The dp-pp-sp-tp
+# mesh additionally keeps the model axis automatic (heads shard on
+# model, each shard's remainder scatters over seq: n_heads % (sp*tp)).
+
+ULYSSES_PP_CFG = dataclasses.replace(PP_CFG, attention="ulysses")
+
+ULYSSES_PP_MESHES = {
+    "pp-sp": (("stage", 4), ("seq", 2)),
+    "dp-pp-sp": (("data", 2), ("stage", 2), ("seq", 2)),
+    "pp-sp-tp": (("stage", 2), ("seq", 2), ("model", 2)),
+}
+
+
+@pytest.mark.parametrize("axes", ULYSSES_PP_MESHES.values(),
+                         ids=ULYSSES_PP_MESHES.keys())
+def test_pipeline_ulysses_forward_matches_plain_scan(axes):
+    import functools
+
+    mesh = mesh_from(axes)
+    params = init_params(jax.random.PRNGKey(0), ULYSSES_PP_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+    got = jax.jit(functools.partial(
+        forward, cfg=ULYSSES_PP_CFG, mesh=mesh
+    ))(shard_params(mesh, params), tokens)
+    want = forward(params, tokens, DENSE_CFG)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-4)
+
+
+def test_pipeline_ulysses_gradients_match_plain_scan():
+    import functools
+
+    mesh = mesh_from(ULYSSES_PP_MESHES["dp-pp-sp"])
+    params = init_params(jax.random.PRNGKey(0), ULYSSES_PP_CFG)
+    batch = jax.random.randint(jax.random.PRNGKey(2), (8, 33), 0, 128)
+    got = jax.jit(jax.grad(functools.partial(
+        loss_fn, cfg=ULYSSES_PP_CFG, mesh=mesh
+    )))(shard_params(mesh, params), shard_batch(mesh, batch))
+    want = jax.grad(loss_fn)(params, batch, DENSE_CFG)
+    for name in want:
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]), atol=5e-3,
+            err_msg=name,
+        )
+
+
+def test_pipeline_ulysses_train_step_runs_and_learns():
+    mesh = mesh_from(ULYSSES_PP_MESHES["pp-sp-tp"])
+    params = shard_params(
+        mesh, init_params(jax.random.PRNGKey(0), ULYSSES_PP_CFG)
+    )
+    init_opt, train_step = make_train_step(ULYSSES_PP_CFG, mesh=mesh)
     opt_state = init_opt(params)
     batch = shard_batch(mesh, jax.random.randint(
         jax.random.PRNGKey(3), (8, 33), 0, 128

@@ -566,25 +566,18 @@ def test_train_payload_resumes_on_expert_mesh(tmp_path):
         assert ckpt.latest_step() == 4
 
 
-def test_train_payload_runs_stage_seq_mesh_with_ring(tmp_path):
-    """The seq x stage cell converted in round 3: training runs on a
-    stage+seq mesh with ring attention riding the pipeline's manual
-    axes; ulysses on the same mesh is still refused loudly."""
+def test_train_payload_runs_stage_seq_mesh_with_ring_and_ulysses(tmp_path):
+    """The seq x stage cell: ring converted in round 3, ulysses in round
+    4 (VERDICT r3 #4) — BOTH strategies now train on a stage+seq mesh,
+    their per-device bodies riding the pipeline's manual axes."""
     corpus = _write_train_corpus(tmp_path)
-    result = run_train_payload(_cfg(
-        tmp_path, payload="train", train_corpus=corpus, train_steps=2,
-        train_batch=8, train_seq=16,
-        mesh=MeshSpec(axes=(("seq", 2), ("stage", 4))),
-    ))
-    assert result.ok, result.error
-
-    rejected = run_train_payload(_cfg(
-        tmp_path, payload="train", train_corpus=corpus, train_steps=2,
-        train_batch=8, train_seq=16, payload_attention="ulysses",
-        mesh=MeshSpec(axes=(("seq", 2), ("stage", 4))),
-    ))
-    assert not rejected.ok
-    assert "ulysses" in rejected.error
+    for attention in ("", "ulysses"):  # "" = auto (ring)
+        result = run_train_payload(_cfg(
+            tmp_path, payload="train", train_corpus=corpus, train_steps=2,
+            train_batch=8, train_seq=16, payload_attention=attention,
+            mesh=MeshSpec(axes=(("seq", 2), ("stage", 4))),
+        ))
+        assert result.ok, (attention, result.error)
 
 
 @pytest.mark.parametrize("attention,axes,fragment", [
