@@ -328,6 +328,16 @@ def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
 
 SPEC_DRAFT_LEN = 4
 
+# The demonstrated speculative-decode crossover shape: ONE definition,
+# shared with tools/bench_spec_crossover.py so the headline
+# spec_decode_big_* metrics always measure exactly the shape the
+# committed SPEC_CROSSOVER_r04.json curve names.
+SPEC_BIG = dataclasses.replace(
+    FLAGSHIP, n_layers=16, d_model=1024, d_ff=4096, n_heads=16,
+    n_kv_heads=4,
+)
+SPEC_BIG_NAME = "L16-d1024"
+
 
 def measure_speculative(cfg, prompt_len: int, n_new: int,
                         draft_len: int = SPEC_DRAFT_LEN):
@@ -463,15 +473,11 @@ def main() -> int:
     # crossover study (tools/bench_spec_crossover.py,
     # SPEC_CROSSOVER_r04.json) shows the speedup growing with model
     # cost — single-row decode is weight-bandwidth-bound, so a verify
-    # pass streams the same weights as one decode step. L16-d1024
-    # (209M params) is the measured crossover shape (>= 1.3x): 1.67x
-    # there, 1.84x at 770M.
-    spec_big = dataclasses.replace(
-        FLAGSHIP, n_layers=16, d_model=1024, d_ff=4096, n_heads=16,
-        n_kv_heads=4,
-    )
+    # pass streams the same weights as one decode step. SPEC_BIG
+    # (L16-d1024, 209M params) is the measured crossover shape
+    # (>= 1.3x): 1.67x there, 1.84x at 770M.
     spec_big_tps, spec_big_plain_tps, spec_big_accept = measure_speculative(
-        spec_big, DECODE_PROMPT, DECODE_NEW
+        SPEC_BIG, DECODE_PROMPT, DECODE_NEW
     )
     naive_ms, flash_ms, flash_speedup = measure_longcontext_attention()
     flash_big_ms = measure_flash_only(seq=8192, bh=64)

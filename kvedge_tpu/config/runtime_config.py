@@ -244,6 +244,11 @@ class RuntimeConfig:
     serving_slots: int = 4
     serving_page_size: int = 16
     serving_pages: int = 0
+    # Prefill granule for the paged backend: prompts land in chunks of
+    # this many tokens, with the admission lock released between chunks
+    # (in-flight decode proceeds) and one compiled program per chunk
+    # length instead of per prompt length. 0 = whole-prompt prefill.
+    serving_prefill_chunk: int = 64
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -355,6 +360,10 @@ class RuntimeConfig:
                 serving_pages=int(
                     payload_doc.get("serving_pages", cls.serving_pages)
                 ),
+                serving_prefill_chunk=int(
+                    payload_doc.get("serving_prefill_chunk",
+                                    cls.serving_prefill_chunk)
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -411,6 +420,11 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving_pages must be >= 0 (0 = auto-size so "
                 "every slot fits a worst-case request)"
+            )
+        if self.serving_prefill_chunk < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_prefill_chunk must be >= 0 "
+                "(0 = whole-prompt prefill)"
             )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
@@ -482,6 +496,7 @@ class RuntimeConfig:
             f"serving_slots = {self.serving_slots}\n"
             f"serving_page_size = {self.serving_page_size}\n"
             f"serving_pages = {self.serving_pages}\n"
+            f"serving_prefill_chunk = {self.serving_prefill_chunk}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

@@ -192,37 +192,80 @@ class PagedKVCache:
                 f"admit({slot}) reserved {self._host_lengths[slot]} positions, "
                 f"prefill got {prompt_len}"
             )
+        return self.prefill_chunk(params, slot, prompt, 0)
+
+    def prefill_chunk(self, params: dict, slot: int, tokens,
+                      offset: int) -> jax.Array:
+        """Feed ``tokens`` into ``slot`` at absolute position ``offset``.
+
+        The chunked-prefill granule (models/serving.py): a long prompt
+        lands in fixed-size chunks so (a) XLA compiles one program per
+        CHUNK length, not per prompt length — a bounded compile surface
+        under arbitrary operator traffic — and (b) the serving loop can
+        run batched decode steps for in-flight requests between chunks
+        instead of blocking every co-tenant for one admission's whole
+        prefill. Causality across chunks is free: earlier chunks'
+        K/V are already scattered into the slot's pages, and the gather
+        masks on absolute positions. Returns the chunk's last-position
+        logits [V] (only the final chunk's matter to the caller).
+        """
+        (n,) = tokens.shape
+        if offset + n > self._host_lengths[slot]:
+            raise PagedCacheError(
+                f"chunk [{offset}, {offset + n}) exceeds slot {slot}'s "
+                f"admitted length {self._host_lengths[slot]}"
+            )
         logits, self.state = _paged_prefill(
-            params, self.state, prompt, slot, self.cfg
+            params, self.state, tokens, slot, self.cfg, offset
         )
         return logits
 
-    def step(self, params: dict, tokens) -> jax.Array:
+    def _step_slots(self, active) -> list[int]:
+        """Admitted slots this step advances. ``active`` (bool [slots])
+        restricts to the caller's in-flight set — the serving loop
+        passes it so a HALF-PREFILLED co-tenant (admitted, tables live,
+        chunks still landing) is neither grown, scattered into, nor
+        length-advanced by interleaved decode steps. None = every
+        admitted slot (the pre-chunking behavior)."""
+        if active is None:
+            return list(self._pages_of)
+        return [s for s in self._pages_of if active[s]]
+
+    @staticmethod
+    def _active_array(state: PagedState, active):
+        import numpy as _np
+
+        if active is None:
+            return state.lengths > 0
+        return jnp.asarray(_np.asarray(active, bool))
+
+    def step(self, params: dict, tokens, active=None) -> jax.Array:
         """One batched decode step over every active slot.
 
         ``tokens`` is [slots] int32; inactive slots' outputs are garbage
         (masked sequences) and their lengths do not advance. Returns
         logits [slots, V].
         """
-        active = [s for s in self._pages_of]
+        slots = self._step_slots(active)
         grew = False
-        for slot in active:
+        for slot in slots:
             grew |= self.grow(slot)
         if grew:
             # Device tables are stale only when a page was allocated; the
             # steady-state token step pays no host->device re-upload.
             self._sync()
         logits, self.state = _paged_decode_step(
-            params, self.state, tokens, self.cfg
+            params, self.state, tokens, self.cfg,
+            self._active_array(self.state, active),
         )
         # The device state already advanced active slots' lengths (the
         # active mask in _paged_decode_step); just mirror on the host —
         # tables only change in admit/grow/release, which sync themselves.
-        for slot in active:
+        for slot in slots:
             self._host_lengths[slot] += 1
         return logits
 
-    def step_window(self, params, tokens, n_steps: int):
+    def step_window(self, params, tokens, n_steps: int, active=None):
         """``n_steps`` greedy decode steps in ONE dispatched program.
 
         The per-token host round trip is the paged path's tax: page
@@ -240,16 +283,17 @@ class PagedKVCache:
         Greedy only — sampled slots need the per-step path (their key
         schedule folds a host-side step index).
         """
-        active = [s for s in self._pages_of]
+        slots = self._step_slots(active)
         grew = False
-        for slot in active:
+        for slot in slots:
             grew |= self.grow_to(slot, n_steps)
         if grew:
             self._sync()
         toks, self.state = _paged_decode_window(
-            params, self.state, tokens, self.cfg, n_steps
+            params, self.state, tokens, self.cfg, n_steps,
+            self._active_array(self.state, active),
         )
-        for slot in active:
+        for slot in slots:
             self._host_lengths[slot] += n_steps
         return toks
 
@@ -320,8 +364,10 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
         new_pool_k = _scatter_token(pool_k_l, tables, lengths, k[:, 0], active)
         new_pool_v = _scatter_token(pool_v_l, tables, lengths, v[:, 0], active)
     else:
-        # Prefill: scatter q_len rows of one slot. Positions are
-        # 0..q_len-1 because admit() starts the sequence at zero.
+        # Prefill: scatter q_len rows of one slot at their ABSOLUTE
+        # positions (chunked prefill passes an offset, so a chunk's
+        # positions are offset..offset+q_len-1; the first/whole-prompt
+        # chunk starts at zero).
         tables = state.tables[slot][None]
         page = pool_k_l.shape[1]
         positions = q_positions[0]
@@ -377,12 +423,13 @@ def _run_paged(cfg, params, state, x, q_positions, slot=None):
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def _paged_prefill(params: dict, state: PagedState, prompt, slot,
-                   cfg: TransformerConfig):
-    # ``slot`` is traced (it is only ever an index), so XLA compiles one
-    # program per prompt length, not one per (slot, length) pair.
+                   cfg: TransformerConfig, offset=0):
+    # ``slot`` and ``offset`` are traced (they are only ever indices),
+    # so XLA compiles one program per CHUNK length, not one per
+    # (slot, offset, length) triple.
     dtype = jnp.dtype(cfg.dtype)
     x = params["embedding"][prompt][None].astype(dtype)  # [1, T, D]
-    q_positions = jnp.arange(prompt.shape[0])[None]
+    q_positions = (offset + jnp.arange(prompt.shape[0]))[None]
     logits, new_k, new_v = _run_paged(
         cfg, params, state, x, q_positions, slot
     )
@@ -390,15 +437,21 @@ def _paged_prefill(params: dict, state: PagedState, prompt, slot,
 
 
 def _decode_step_core(params: dict, state: PagedState, tokens,
-                      cfg: TransformerConfig):
+                      cfg: TransformerConfig, active):
     """One batched decode step (traceable body shared by the jitted
     single step and the windowed scan — the two must stay the same
-    program so windowed and per-step decode agree token for token)."""
+    program so windowed and per-step decode agree token for token).
+    ``active`` [B] bool gates the scatter and the length advance —
+    lengths>0 is NOT sufficient once chunked prefill exists (a
+    half-prefilled slot is admitted with its final length but must not
+    be touched by decode)."""
     dtype = jnp.dtype(cfg.dtype)
     x = params["embedding"][tokens][:, None].astype(dtype)  # [B, 1, D]
     q_positions = state.lengths[:, None]  # [B, 1]
-    logits, new_k, new_v = _run_paged(cfg, params, state, x, q_positions)
-    active = (state.lengths > 0)
+    masked = dataclasses.replace(
+        state, lengths=jnp.where(active, state.lengths, 0)
+    )
+    logits, new_k, new_v = _run_paged(cfg, params, masked, x, q_positions)
     return logits, dataclasses.replace(
         state,
         pool_k=new_k,
@@ -409,14 +462,14 @@ def _decode_step_core(params: dict, state: PagedState, tokens,
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def _paged_decode_step(params: dict, state: PagedState, tokens,
-                       cfg: TransformerConfig):
-    return _decode_step_core(params, state, tokens, cfg)
+                       cfg: TransformerConfig, active):
+    return _decode_step_core(params, state, tokens, cfg, active)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_steps"),
                    donate_argnums=(1,))
 def _paged_decode_window(params: dict, state: PagedState, tokens,
-                         cfg: TransformerConfig, n_steps: int):
+                         cfg: TransformerConfig, n_steps: int, active):
     """``n_steps`` decode steps with greedy feedback, one program.
 
     The scan carries (state, pending token); each step feeds the pending
@@ -425,7 +478,7 @@ def _paged_decode_window(params: dict, state: PagedState, tokens,
     """
     def body(carry, _):
         state, toks = carry
-        logits, state = _decode_step_core(params, state, toks, cfg)
+        logits, state = _decode_step_core(params, state, toks, cfg, active)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return (state, nxt), nxt
 

@@ -133,11 +133,17 @@ class PagedGenerationServer:
     """
 
     def __init__(self, params: dict, cfg, *, slots: int = 4,
-                 pages: int = 64, page_size: int = 16):
+                 pages: int = 64, page_size: int = 16,
+                 prefill_chunk: int = 0):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
         self._cfg = cfg
+        # Chunked prefill granule (0 = whole-prompt): long prompts land
+        # in fixed-size chunks with the lock RELEASED between chunks, so
+        # in-flight requests keep decoding during an admission and XLA
+        # compiles per chunk length instead of per prompt length.
+        self._prefill_chunk = prefill_chunk
         self._cache = PagedKVCache(
             cfg, slots=slots, pages=pages, page_size=page_size
         )
@@ -149,6 +155,11 @@ class PagedGenerationServer:
         self._free_slots = list(range(slots))[::-1]
         self._closed = False
         self._draining = False
+        # Admissions whose chunked prefill is in flight (slot granted,
+        # not yet in _active): the decode loop must not exit — and a
+        # drain must not report done — while any exist, or their
+        # waiters would hang on a request no loop will ever serve.
+        self._prefilling = 0
         self._thread = threading.Thread(
             target=self._loop, name="kvedge-paged-serve", daemon=True
         )
@@ -251,19 +262,56 @@ class PagedGenerationServer:
             slot = self._free_slots.pop()
             self._reserved += pages_needed
             try:
-                # Prefill under the lock: it mutates cache state the step
-                # loop reads. Per-sequence prefill compiles once per
-                # distinct prompt length (static shapes).
                 self._cache.admit(slot, len(req.prompt))
-                logits = self._cache.prefill(
-                    self._params, slot, jnp.asarray(req.prompt, jnp.int32)
-                )
-                req.next_token = req.pick(logits, 0)
             except Exception:
                 self._release_locked(slot, pages_needed)
                 raise
-            self._active[slot] = req
-            self._work.notify_all()  # wake the decode loop
+            self._prefilling += 1
+        # Prefill in chunks, the lock held only PER CHUNK: the decode
+        # loop interleaves batched steps for in-flight requests between
+        # chunks (they never touch this slot — the loop's active mask
+        # excludes anything not yet in self._active), so one admission's
+        # long prompt no longer stalls every co-tenant; and XLA compiles
+        # one program per CHUNK length instead of per prompt length —
+        # a bounded compile surface under arbitrary operator traffic.
+        # Each cache call still happens under the lock: cache state
+        # mutations must serialize against the step loop.
+        chunk = self._prefill_chunk or len(req.prompt)
+        activated = False
+        try:
+            logits = None
+            off = 0
+            while off < len(req.prompt):
+                piece = req.prompt[off:off + chunk]
+                with self._work:
+                    if self._closed:
+                        raise ServerClosed("server shut down mid-prefill")
+                    if req.cancelled:
+                        raise RequestCancelled(
+                            "request cancelled during prefill"
+                        )
+                    logits = self._cache.prefill_chunk(
+                        self._params, slot,
+                        jnp.asarray(piece, jnp.int32), off,
+                    )
+                off += len(piece)
+            with self._work:
+                # Re-check under the activation lock: a hard close can
+                # land between the last chunk and here, after which no
+                # loop is alive to serve (or poison) this request.
+                if self._closed:
+                    raise ServerClosed("server shut down mid-prefill")
+                req.next_token = req.pick(logits, 0)
+                self._active[slot] = req
+                self._prefilling -= 1
+                activated = True
+                self._work.notify_all()  # wake the decode loop
+        except Exception:
+            with self._work:
+                if not activated:
+                    self._prefilling -= 1
+                    self._release_locked(slot, pages_needed)
+            raise
         return req
 
     def close(self, drain: bool = False) -> None:
@@ -385,10 +433,15 @@ class PagedGenerationServer:
         while True:
             with self._work:
                 while (not self._active and not self._closed
-                       and not self._draining):
+                       and not (self._draining
+                                and not self._prefilling)):
                     self._work.wait()
-                if self._draining and not self._active:
-                    return  # drained: every accepted request finished
+                if (self._draining and not self._active
+                        and not self._prefilling):
+                    # Drained: every accepted request — including any
+                    # whose chunked prefill was in flight when the
+                    # drain began — has finished.
+                    return
                 if self._closed:
                     for req in self._active.values():
                         req.error = ServerClosed("server shut down mid-"
@@ -434,9 +487,14 @@ class PagedGenerationServer:
                         continue
                     # Feed every active slot's pending token through ONE
                     # batched step; inactive slots carry zeros (masked).
+                    # The explicit mask (not "every admitted slot") is
+                    # what keeps interleaved chunked prefills safe: a
+                    # half-prefilled slot is admitted but NOT active.
                     tokens = np.zeros((self._cache.slots,), np.int32)
+                    mask = np.zeros((self._cache.slots,), bool)
                     for slot, req in self._active.items():
                         tokens[slot] = req.next_token
+                        mask[slot] = True
                     window = self._window_steps()
                     if window > 1:
                         # Device-side window: `window` greedy steps in
@@ -446,7 +504,8 @@ class PagedGenerationServer:
                         # (a submitter blocks on this lock until the
                         # window returns, then joins the next one).
                         produced = np.asarray(self._cache.step_window(
-                            self._params, jnp.asarray(tokens), window
+                            self._params, jnp.asarray(tokens), window,
+                            active=mask,
                         ))
                         for slot, req in self._active.items():
                             self._emit(req, req.next_token)
@@ -455,7 +514,7 @@ class PagedGenerationServer:
                             req.next_token = int(produced[window - 1, slot])
                         continue
                     logits = self._cache.step(
-                        self._params, jnp.asarray(tokens)
+                        self._params, jnp.asarray(tokens), active=mask
                     )
                     next_tokens = self._next_tokens(logits)
                     for slot, req in self._active.items():

@@ -1059,6 +1059,7 @@ def run_serve_payload(cfg: RuntimeConfig):
             paged_server = PagedGenerationServer(
                 params, tcfg, slots=slots, pages=pages,
                 page_size=page_size,
+                prefill_chunk=cfg.serving_prefill_chunk,
             )
             # One shared pool for row priming AND stream pumping, sized
             # 2x slots (only `slots` rows decode concurrently; one

@@ -167,6 +167,95 @@ def test_window_steps_equal_single_steps():
     assert cache_w._host_lengths[1] == 0
 
 
+def test_chunked_prefill_matches_whole_prefill():
+    """kvcache.prefill_chunk: a prompt landed in chunks must leave the
+    cache in the same state as one whole-prompt prefill — same final
+    logits, then same decode tokens."""
+    from kvedge_tpu.models.kvcache import PagedKVCache
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=16, n_heads=2, n_kv_heads=2, n_layers=2,
+        d_ff=32, max_seq=64,
+    )
+    p = init_params(jax.random.PRNGKey(1), cfg)
+    prompt = list(
+        np.asarray(jax.random.randint(
+            jax.random.PRNGKey(2), (11,), 0, 64)).tolist()
+    )
+
+    def decode_from(cache, logits, n):
+        toks = [int(jnp.argmax(logits))]
+        pend = np.zeros((2,), np.int32)
+        for _ in range(n - 1):
+            pend[0] = toks[-1]
+            step_logits = cache.step(p, jnp.asarray(pend))
+            toks.append(int(jnp.argmax(step_logits[0])))
+        return toks
+
+    whole = PagedKVCache(cfg, slots=2, pages=16, page_size=4)
+    whole.admit(0, len(prompt))
+    logits_w = whole.prefill(p, 0, jnp.asarray(prompt, jnp.int32))
+    want = decode_from(whole, logits_w, 6)
+
+    chunked = PagedKVCache(cfg, slots=2, pages=16, page_size=4)
+    chunked.admit(0, len(prompt))
+    off = 0
+    for size in (3, 3, 3, 2):  # 11 tokens, uneven final chunk
+        piece = jnp.asarray(prompt[off:off + size], jnp.int32)
+        logits_c = chunked.prefill_chunk(p, 0, piece, off)
+        off += size
+    got = decode_from(chunked, logits_c, 6)
+    assert got == want
+
+
+def test_chunked_admission_equivalence_and_interleaving(params):
+    """Serving with a tiny prefill chunk: tokens still equal the
+    contiguous decode, and an in-flight request keeps DECODING while a
+    long prompt's chunks land (the admission lock releases between
+    chunks; the decode loop's active mask protects the half-prefilled
+    slot)."""
+    import time
+
+    server = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                   prefill_chunk=2)
+    try:
+        # Equivalence with chunked admission (prompt of 7 -> 4 chunks).
+        prompt = [5, 9, 2, 7, 1, 3, 3]
+        assert server.submit(prompt, n_new=6) == reference(
+            params, prompt, 6
+        )
+
+        # Interleaving: request A streams with a large budget; during
+        # B's chunked prefill (each chunk artificially slowed to 0.15s),
+        # the decode loop must keep stepping A — by the time B's submit
+        # returns, A's tokens are BUFFERED in its stream queue. Under
+        # the old whole-prefill-under-the-lock behavior A would be
+        # frozen for the entire admission and have almost nothing.
+        src = server.submit_stream([3, 1, 4], n_new=61)
+        a_tokens = [next(src)]
+        real_chunk = server._cache.prefill_chunk
+
+        def slow_chunk(*args, **kwargs):
+            time.sleep(0.15)
+            return real_chunk(*args, **kwargs)
+
+        server._cache.prefill_chunk = slow_chunk
+        long_prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]  # 5 slow chunks
+        got_b = server.submit(long_prompt, n_new=3)
+        server._cache.prefill_chunk = real_chunk
+        buffered = src._req.stream.qsize()
+        assert buffered >= 30, (
+            f"only {buffered} of A's tokens buffered during B's slowed "
+            "admission — the decode loop did not interleave"
+        )
+        a_tokens += list(src)
+        assert len(a_tokens) == 61
+        assert [3, 1, 4] + a_tokens == reference(params, [3, 1, 4], 61)
+        assert got_b == reference(params, long_prompt, 3)
+    finally:
+        server.close()
+
+
 def test_slot_reuse_after_release(params):
     server = PagedGenerationServer(params, CFG, slots=1, pages=8)
     try:
@@ -200,9 +289,12 @@ def test_admission_control_rejects_impossible_and_times_out(params):
 
         real_window = server._cache.step_window
 
-        def slow_window(params_, tokens, n_steps):
-            time_mod.sleep(0.1)
-            return real_window(params_, tokens, n_steps)
+        def slow_window(*args, **kwargs):
+            # Sleep > the competitor's full timeout: even a single
+            # window outlasts it, so scheduling jitter cannot let the
+            # occupier finish early.
+            time_mod.sleep(0.25)
+            return real_window(*args, **kwargs)
 
         server._cache.step_window = slow_window
         t = threading.Thread(
@@ -286,6 +378,43 @@ def test_drain_close_finishes_accepted_requests(params):
     # Admission is closed from the drain call onward.
     with pytest.raises(ServerClosed):
         server.submit([7], n_new=2)
+
+
+def test_drain_during_chunked_prefill_serves_the_request(params):
+    """A drain that begins while an admission's chunks are still landing
+    must still serve that request (it was accepted — its slot is
+    granted): the decode loop may not exit while a prefill is in
+    flight, or the waiter would hang on a request no loop serves."""
+    import time
+
+    server = PagedGenerationServer(params, CFG, slots=2, pages=16,
+                                   prefill_chunk=1)
+    real_chunk = server._cache.prefill_chunk
+
+    def slow_chunk(*args, **kwargs):
+        time.sleep(0.05)
+        return real_chunk(*args, **kwargs)
+
+    server._cache.prefill_chunk = slow_chunk
+    result: list = []
+    errors: list = []
+
+    def worker():
+        try:
+            result.append(server.submit([5, 9, 2, 7, 1, 3], n_new=4))
+        except Exception as e:
+            errors.append(e)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    deadline = time.monotonic() + 30
+    while server._prefilling == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert server._prefilling == 1  # drain begins MID-prefill
+    server.close(drain=True)
+    t.join(timeout=60)
+    assert not errors, errors
+    assert result and result[0] == reference(params, [5, 9, 2, 7, 1, 3], 4)
 
 
 def test_close_fails_pending_requests(params):
