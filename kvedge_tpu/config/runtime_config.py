@@ -249,6 +249,12 @@ class RuntimeConfig:
     # (in-flight decode proceeds) and one compiled program per chunk
     # length instead of per prompt length. 0 = whole-prompt prefill.
     serving_prefill_chunk: int = 64
+    # Prefix sharing for the paged backend: completed prompts register
+    # page-aligned prefixes; a later prompt with the same prefix reuses
+    # the pinned K/V pages read-only and prefills only its suffix
+    # (exact — K/V depend only on prompt tokens/positions). Pins are
+    # evicted LRU under pool pressure.
+    serving_prefix_cache: bool = True
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -364,6 +370,9 @@ class RuntimeConfig:
                     payload_doc.get("serving_prefill_chunk",
                                     cls.serving_prefill_chunk)
                 ),
+                serving_prefix_cache=payload_doc.get(
+                    "serving_prefix_cache", cls.serving_prefix_cache
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -425,6 +434,10 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving_prefill_chunk must be >= 0 "
                 "(0 = whole-prompt prefill)"
+            )
+        if not isinstance(self.serving_prefix_cache, bool):
+            raise RuntimeConfigError(
+                "[payload] serving_prefix_cache must be a boolean"
             )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
@@ -497,6 +510,8 @@ class RuntimeConfig:
             f"serving_page_size = {self.serving_page_size}\n"
             f"serving_pages = {self.serving_pages}\n"
             f"serving_prefill_chunk = {self.serving_prefill_chunk}\n"
+            "serving_prefix_cache = "
+            f"{'true' if self.serving_prefix_cache else 'false'}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

@@ -98,6 +98,17 @@ class PagedKVCache:
             [0] * self.max_pages_per_seq for _ in range(slots)
         ]
         self._host_lengths = [0] * slots
+        # Page reference counts (prefix sharing): a page may be held by
+        # several slots' tables at once (read-only shared prompt
+        # prefixes) and/or by the serving layer's prefix registry
+        # (retain_pages). A page returns to the free list only when its
+        # count reaches zero. Pages on the free list carry count 0.
+        self._refs = [0] * pages
+        # Optional callback (serving layer): registry pins live outside
+        # every request's worst-case reservation, so an allocation that
+        # finds the free list short asks the owner to reclaim pins
+        # before failing. Signature: pressure_relief(needed) -> bool.
+        self.pressure_relief = None
 
     # ---- control plane (host) -------------------------------------------
 
@@ -107,21 +118,73 @@ class PagedKVCache:
     def is_admitted(self, slot: int) -> bool:
         return slot in self._pages_of
 
-    def admit(self, slot: int, prompt_len: int) -> None:
-        """Reserve pages for a prompt landing in ``slot``."""
+    def slot_pages(self, slot: int) -> list[int]:
+        """The slot's current page list (a copy — callers registering
+        prefix pins must not alias the live allocation list)."""
+        return list(self._pages_of[slot])
+
+    def retain_pages(self, pages: list[int]) -> None:
+        """Take an extra reference on ``pages`` (the serving layer's
+        prefix registry pins cached-prefix pages with this so releasing
+        the request that wrote them does not free them)."""
+        for page in pages:
+            if self._refs[page] < 1:
+                raise PagedCacheError(
+                    f"page {page} is free — cannot retain K/V that no "
+                    "longer exists"
+                )
+            self._refs[page] += 1
+
+    def release_pages(self, pages: list[int]) -> None:
+        """Drop a reference taken with :meth:`retain_pages`."""
+        for page in pages:
+            self._unref(page)
+
+    def _unref(self, page: int) -> None:
+        self._refs[page] -= 1
+        if self._refs[page] < 0:
+            raise PagedCacheError(f"page {page} over-released")
+        if self._refs[page] == 0:
+            self._free.append(page)
+
+    def admit(self, slot: int, prompt_len: int,
+              shared_pages: tuple[int, ...] = ()) -> None:
+        """Reserve pages for a prompt landing in ``slot``.
+
+        ``shared_pages`` (prefix sharing) prepends already-written,
+        read-only pages holding the prompt's cached prefix: the slot's
+        table starts with them (reference counts bumped — they are
+        never written by this slot, because prefill starts at the
+        shared token count and decode writes past the prompt), and only
+        the remainder allocates from the free list.
+        """
         if slot in self._pages_of:
             raise PagedCacheError(f"slot {slot} already admitted")
-        needed = -(-prompt_len // self.page_size) or 1
-        if needed > self.max_pages_per_seq:
+        total = -(-prompt_len // self.page_size) or 1
+        needed = total - len(shared_pages)
+        if needed < 0:
             raise PagedCacheError(
-                f"prompt of {prompt_len} needs {needed} pages > "
+                f"{len(shared_pages)} shared pages exceed the prompt's "
+                f"{total}-page footprint"
+            )
+        if total > self.max_pages_per_seq:
+            raise PagedCacheError(
+                f"prompt of {prompt_len} needs {total} pages > "
                 f"max_pages_per_seq={self.max_pages_per_seq}"
             )
-        if needed > len(self._free):
+        if needed > len(self._free) and not (
+            self.pressure_relief and self.pressure_relief(needed)
+        ):
             raise PagedCacheError(
                 f"pool exhausted: need {needed} pages, {len(self._free)} free"
             )
-        self._pages_of[slot] = [self._free.pop() for _ in range(needed)]
+        self.retain_pages(list(shared_pages))
+        fresh = []
+        for _ in range(needed):
+            page = self._free.pop()
+            self._refs[page] += 1
+            fresh.append(page)
+        self._pages_of[slot] = list(shared_pages) + fresh
         row = self._host_tables[slot]
         for i, page in enumerate(self._pages_of[slot]):
             row[i] = page
@@ -152,20 +215,23 @@ class PagedKVCache:
         while length + n > len(pages) * self.page_size:
             if len(pages) == self.max_pages_per_seq:
                 raise PagedCacheError(f"slot {slot} hit max_pages_per_seq")
-            if not self._free:
+            if not self._free and not (
+                self.pressure_relief and self.pressure_relief(1)
+            ):
                 raise PagedCacheError("pool exhausted mid-decode")
             page = self._free.pop()
+            self._refs[page] += 1
             pages.append(page)
             self._host_tables[slot][len(pages) - 1] = page
             grew = True
         return grew
 
     def release(self, slot: int) -> None:
-        """Finish a sequence: return its pages to the pool."""
+        """Finish a sequence: drop its references (pages free at 0)."""
         if slot not in self._pages_of:
             raise PagedCacheError(f"slot {slot} is not admitted")
         for page in self._pages_of.pop(slot):
-            self._free.append(page)
+            self._unref(page)
         self._host_tables[slot] = [0] * self.max_pages_per_seq
         self._host_lengths[slot] = 0
         self._sync()

@@ -134,7 +134,7 @@ class PagedGenerationServer:
 
     def __init__(self, params: dict, cfg, *, slots: int = 4,
                  pages: int = 64, page_size: int = 16,
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0, prefix_cache: bool = True):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
@@ -147,6 +147,40 @@ class PagedGenerationServer:
         self._cache = PagedKVCache(
             cfg, slots=slots, pages=pages, page_size=page_size
         )
+        # Prefix sharing: completed prompts register their page-aligned
+        # prefixes here (key: token tuple -> pinned pages + LRU stamp);
+        # a later prompt with the same prefix starts its table on those
+        # READ-ONLY pages and prefills only the suffix. K/V depend only
+        # on the prompt tokens and positions, so reuse is exact — for
+        # sampled requests too. Capacity stays sound with zero
+        # accounting changes: admission still reserves the WORST-CASE
+        # page budget (sharing saves compute and physical pages, not
+        # reservation), and registry pins are evicted LRU on demand —
+        # excluding the entry being shared from — which is always
+        # sufficient because every other allocation is within its own
+        # reservation.
+        self._prefix_enabled = prefix_cache
+        # Trie over page-sized token blocks (NOT a dict of full-prefix
+        # tuples: that costs O(len^2/page) hashing under the lock per
+        # admission/registration). Node 0 is the root; an edge is
+        # (parent_id, block_tuple) -> child_id; a node may carry an
+        # entry {"pages": pinned page list, "last_used": LRU stamp}.
+        # Lookup and registration walk the prompt once — O(len(prompt))
+        # total hashing — and eviction prunes childless, entry-less
+        # nodes upward so the trie never outlives its entries.
+        self._prefix_children: dict[tuple, int] = {}
+        self._prefix_nodes: dict[int, dict] = {
+            0: {"parent": None, "children": 0, "entry": None},
+        }
+        self._prefix_entry_nodes: dict[int, dict] = {}  # id -> entry
+        self._prefix_next_id = 1
+        self._prefix_hits = 0
+        self._prefix_tokens_saved = 0
+        # Registry pins live OUTSIDE any request's reservation, so the
+        # cache needs a way to reclaim them when a mid-decode grow finds
+        # the free list empty — otherwise one tenant's growth would
+        # poison the whole server (see _relieve_pool_pressure).
+        self._cache.pressure_relief = self._relieve_pool_pressure
         self._pages_total = pages
         self._reserved = 0  # worst-case pages of every in-flight request
         self._lock = threading.Lock()
@@ -261,12 +295,22 @@ class PagedGenerationServer:
                 )
             slot = self._free_slots.pop()
             self._reserved += pages_needed
+            # Prefix sharing: start the table on the cached prefix's
+            # read-only pages and evict LRU registry pins (never the
+            # matched entry) until the free list covers this request's
+            # full PRIVATE budget — so later grows can never starve on
+            # registry-held pages.
+            key, shared, shared_tokens = self._prefix_lookup(req.prompt)
             try:
-                self._cache.admit(slot, len(req.prompt))
+                self._evict_prefixes_for(pages_needed - len(shared), key)
+                self._cache.admit(slot, len(req.prompt), shared)
             except Exception:
                 self._release_locked(slot, pages_needed)
                 raise
             self._prefilling += 1
+            if shared_tokens:
+                self._prefix_hits += 1
+                self._prefix_tokens_saved += shared_tokens
         # Prefill in chunks, the lock held only PER CHUNK: the decode
         # loop interleaves batched steps for in-flight requests between
         # chunks (they never touch this slot — the loop's active mask
@@ -280,7 +324,7 @@ class PagedGenerationServer:
         activated = False
         try:
             logits = None
-            off = 0
+            off = shared_tokens  # cached prefix K/V are already in place
             while off < len(req.prompt):
                 piece = req.prompt[off:off + chunk]
                 with self._work:
@@ -305,6 +349,11 @@ class PagedGenerationServer:
                 self._active[slot] = req
                 self._prefilling -= 1
                 activated = True
+                # The fully-prefilled prompt's page-aligned prefixes
+                # are now reusable K/V: pin and register them.
+                self._register_prefixes(
+                    req.prompt, self._cache.slot_pages(slot)
+                )
                 self._work.notify_all()  # wake the decode loop
         except Exception:
             with self._work:
@@ -313,6 +362,104 @@ class PagedGenerationServer:
                     self._release_locked(slot, pages_needed)
             raise
         return req
+
+    # ---- prefix sharing (lock held for every method here) ----------------
+
+    def _prefix_lookup(self, prompt: list[int]):
+        """(node_id, pages, shared_tokens) of the longest registered
+        page-aligned prefix — capped at len(prompt)-1 so at least one
+        token prefills and produces the first-emission logits. One walk
+        down the block trie: O(len(prompt)) hashing."""
+        if not self._prefix_enabled:
+            return None, (), 0
+        page = self._cache.page_size
+        node, best = 0, (None, (), 0)
+        for k in range(1, (len(prompt) - 1) // page + 1):
+            block = tuple(prompt[(k - 1) * page:k * page])
+            child = self._prefix_children.get((node, block))
+            if child is None:
+                break
+            node = child
+            entry = self._prefix_nodes[node]["entry"]
+            if entry is not None:
+                entry["last_used"] = time.monotonic()
+                best = (node, tuple(entry["pages"]), k * page)
+        return best
+
+    def _register_prefixes(self, prompt: list[int],
+                           pages: list[int]) -> None:
+        """Pin every page-aligned prefix of a fully-prefilled prompt.
+        Only full pages covered entirely by PROMPT tokens register —
+        decode writes land past the prompt (the first grow opens a
+        fresh page even at an aligned boundary), so registered pages
+        are immutable. One walk down the trie: O(len(prompt))."""
+        if not self._prefix_enabled:
+            return
+        page = self._cache.page_size
+        node = 0
+        for k in range(1, len(prompt) // page + 1):
+            block = tuple(prompt[(k - 1) * page:k * page])
+            child = self._prefix_children.get((node, block))
+            if child is None:
+                child = self._prefix_next_id
+                self._prefix_next_id += 1
+                self._prefix_children[(node, block)] = child
+                self._prefix_nodes[child] = {
+                    "parent": (node, block), "children": 0, "entry": None,
+                }
+                self._prefix_nodes[node]["children"] += 1
+            node = child
+            if self._prefix_nodes[node]["entry"] is None:
+                held = list(pages[:k])
+                self._cache.retain_pages(held)
+                entry = {"pages": held, "last_used": time.monotonic()}
+                self._prefix_nodes[node]["entry"] = entry
+                self._prefix_entry_nodes[node] = entry
+
+    def _evict_prefix_node(self, node: int) -> None:
+        """Unpin one entry and prune upward while nodes are childless
+        and entry-less — the trie never outlives its entries."""
+        entry = self._prefix_entry_nodes.pop(node)
+        self._prefix_nodes[node]["entry"] = None
+        self._cache.release_pages(entry["pages"])
+        cur = node
+        while (cur != 0 and self._prefix_nodes[cur]["entry"] is None
+               and self._prefix_nodes[cur]["children"] == 0):
+            parent_key = self._prefix_nodes.pop(cur)["parent"]
+            del self._prefix_children[parent_key]
+            cur = parent_key[0]
+            self._prefix_nodes[cur]["children"] -= 1
+
+    def _evict_prefixes_for(self, needed_free: int, keep) -> None:
+        """Evict LRU registry entries (never ``keep``) until the free
+        list can cover ``needed_free`` pages. Always sufficient for an
+        admission within its reservation: every non-registry allocation
+        sits inside some request's reserved budget, and reservations
+        never exceed the pool."""
+        while (self._cache.free_pages() < needed_free
+               and any(n != keep for n in self._prefix_entry_nodes)):
+            victim = min(
+                (n for n in self._prefix_entry_nodes if n != keep),
+                key=lambda n: self._prefix_entry_nodes[n]["last_used"],
+            )
+            self._evict_prefix_node(victim)
+
+    def _relieve_pool_pressure(self, needed: int = 1) -> bool:
+        """Cache callback when an allocation finds the free list short
+        (kvcache.grow/admit): registry pins sit outside every request's
+        reservation, so a mid-decode grow — which IS within its
+        request's reservation — must be able to reclaim them; after all
+        pins are dropped, free >= every in-reservation need. Runs under
+        the server lock (every cache call holds it). Returns True iff
+        ``needed`` pages are now free."""
+        while (self._prefix_entry_nodes
+               and self._cache.free_pages() < needed):
+            victim = min(
+                self._prefix_entry_nodes,
+                key=lambda n: self._prefix_entry_nodes[n]["last_used"],
+            )
+            self._evict_prefix_node(victim)
+        return self._cache.free_pages() >= needed
 
     def close(self, drain: bool = False) -> None:
         """Shut down. Hard close (default) poisons in-flight requests
@@ -340,6 +487,9 @@ class PagedGenerationServer:
                 "free_slots": len(self._free_slots),
                 "free_pages": self._cache.free_pages(),
                 "reserved_pages": self._reserved,
+                "prefix_entries": len(self._prefix_entry_nodes),
+                "prefix_hits": self._prefix_hits,
+                "prefix_tokens_saved": self._prefix_tokens_saved,
             }
 
     # ---- decode loop -----------------------------------------------------

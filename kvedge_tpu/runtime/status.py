@@ -85,6 +85,13 @@ _SERVE_METRIC_FIELDS = (
      "unreferenced KV pages in the pool (paged backend)"),
     ("reserved_pages", "serve_reserved_pages", "gauge",
      "worst-case pages reserved by in-flight requests (paged backend)"),
+    ("prefix_entries", "serve_prefix_entries", "gauge",
+     "registered prefix-cache entries (paged backend)"),
+    ("prefix_hits", "serve_prefix_hits_total", "counter",
+     "admissions that reused a cached prompt prefix (paged backend)"),
+    ("prefix_tokens_saved", "serve_prefix_tokens_saved_total", "counter",
+     "prompt tokens whose prefill was skipped via prefix sharing "
+     "(paged backend)"),
 )
 
 

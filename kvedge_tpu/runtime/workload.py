@@ -1060,6 +1060,7 @@ def run_serve_payload(cfg: RuntimeConfig):
                 params, tcfg, slots=slots, pages=pages,
                 page_size=page_size,
                 prefill_chunk=cfg.serving_prefill_chunk,
+                prefix_cache=cfg.serving_prefix_cache,
             )
             # One shared pool for row priming AND stream pumping, sized
             # 2x slots (only `slots` rows decode concurrently; one

@@ -380,6 +380,169 @@ def test_drain_close_finishes_accepted_requests(params):
         server.submit([7], n_new=2)
 
 
+def test_prefix_sharing_exact_and_skips_shared_prefill(params):
+    """Two requests with a common page-aligned prefix: the second
+    prefills ONLY its suffix (observed via prefill_chunk call counts),
+    and both results equal their own contiguous decodes — reuse is
+    exact, including for a sampled request sharing the greedy request's
+    prefix pages."""
+    import jax
+
+    server = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                   page_size=4, prefill_chunk=4)
+    calls: list = []
+    real_chunk = server._cache.prefill_chunk
+
+    def counting_chunk(params_, slot, tokens, offset):
+        calls.append((int(offset), int(tokens.shape[0])))
+        return real_chunk(params_, slot, tokens, offset)
+
+    server._cache.prefill_chunk = counting_chunk
+    try:
+        base = [7, 3, 9, 1, 5, 5, 2, 8]  # two full 4-token pages
+        first = server.submit(base + [4, 6], n_new=4)
+        assert first == reference(params, base + [4, 6], 4)
+        stats = server.stats()
+        assert stats["prefix_entries"] == 2  # 1-page and 2-page prefixes
+        assert stats["prefix_hits"] == 0
+
+        calls.clear()
+        second = server.submit(base + [9, 9, 9], n_new=4)
+        assert second == reference(params, base + [9, 9, 9], 4)
+        # Only the 3-token suffix prefilled: one chunk at offset 8.
+        assert calls == [(8, 3)], calls
+        stats = server.stats()
+        assert stats["prefix_hits"] == 1
+        assert stats["prefix_tokens_saved"] == 8
+
+        # Sampled request on the same prefix: prefix K/V are
+        # sampling-independent, so tokens match a fresh server that
+        # never shared anything.
+        calls.clear()
+        key = jax.random.PRNGKey(42)
+        sampled = server.submit(
+            base + [2], n_new=5,
+            sampling=(key, jnp.float32(0.8), jnp.float32(0.9)),
+        )
+        assert calls == [(8, 1)], calls
+        fresh = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                      page_size=4, prefix_cache=False)
+        try:
+            want = fresh.submit(
+                base + [2], n_new=5,
+                sampling=(key, jnp.float32(0.8), jnp.float32(0.9)),
+            )
+        finally:
+            fresh.close()
+        assert sampled == want
+    finally:
+        server.close()
+
+
+def test_prefix_pins_evict_under_pool_pressure(params):
+    """Registry pins must never block an admission that fits its
+    reservation: a new request that needs the pinned pages evicts them
+    LRU and proceeds."""
+    server = PagedGenerationServer(params, CFG, slots=1, pages=6,
+                                   page_size=4)
+    try:
+        a = [1, 2, 3, 4, 5, 6, 7, 8]  # 2 pages, both prefixes registered
+        assert server.submit(a, n_new=4) == reference(params, a, 4)
+        assert server.stats()["prefix_entries"] == 2
+        # After A's release the registry pins its 2 prompt pages, so 4
+        # of 6 pages are free. B (unrelated prompt) needs
+        # ceil((8+12)/4) = 5 pages: admission must evict A's pins and
+        # proceed.
+        b = [9, 9, 8, 8, 7, 7, 6, 6]
+        assert server.submit(b, n_new=12) == reference(params, b, 12)
+        # A's prefixes were evicted (a lookup for them finds nothing)...
+        _, _, shared = server._prefix_lookup(a + [0])
+        assert shared == 0
+        # ...and B's own prefixes registered after it completed.
+        assert server.stats()["prefix_entries"] == 2
+        _, _, shared = server._prefix_lookup(b + [0])
+        assert shared == 8
+    finally:
+        server.close()
+
+
+def test_grow_under_registry_pressure_evicts_instead_of_poisoning(params):
+    """Registry pins live outside every request's reservation, so a
+    mid-decode grow can find the free list empty even though the grow
+    is within its own reserved budget. The cache's pressure-relief
+    callback must evict pins and continue — before the fix this raised
+    'pool exhausted mid-decode' in the decode loop, failing every
+    in-flight request and closing the server."""
+    import time
+
+    server = PagedGenerationServer(params, CFG, slots=2, pages=18,
+                                   page_size=4)
+    relief_calls = [0]
+    orig_relief = server._relieve_pool_pressure
+
+    def counting_relief(needed=1):
+        relief_calls[0] += 1
+        return orig_relief(needed)
+
+    server._cache.pressure_relief = counting_relief
+    real_window = server._cache.step_window
+
+    def slow_window(*args, **kwargs):
+        time.sleep(0.25)  # keep B in flight while C-cycles pin pages
+        return real_window(*args, **kwargs)
+
+    server._cache.step_window = slow_window
+    try:
+        b_result: list = []
+        b_errors: list = []
+
+        def b_worker():
+            try:
+                b_result.append(server.submit([3, 1, 4, 1], n_new=56))
+            except Exception as e:
+                b_errors.append(e)
+
+        t = threading.Thread(target=b_worker)
+        t.start()
+        deadline = time.monotonic() + 30
+        while (server.stats()["in_flight"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        # Distinct 2-page prompts complete while B decodes; each
+        # completion pins pages the registry holds beyond any
+        # reservation. B's later grows must reclaim them.
+        for i in range(4):
+            c = [10 + i] * 8
+            assert server.submit(c, n_new=4) == reference(params, c, 4)
+        t.join(timeout=180)
+        assert not b_errors, b_errors
+        assert b_result[0] == reference(params, [3, 1, 4, 1], 56)
+        assert relief_calls[0] >= 1, (
+            "the scenario never exercised pool-pressure relief — "
+            "tighten it"
+        )
+        # The server survived: a fresh request still serves.
+        assert server.submit([9, 9], n_new=2) == reference(
+            params, [9, 9], 2
+        )
+    finally:
+        server._cache.step_window = real_window
+        server.close()
+
+
+def test_prefix_cache_disabled_shares_nothing(params):
+    server = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                   page_size=4, prefix_cache=False)
+    try:
+        a = [1, 2, 3, 4, 5, 6, 7, 8]
+        assert server.submit(a, n_new=3) == reference(params, a, 3)
+        stats = server.stats()
+        assert stats["prefix_entries"] == 0
+        assert stats["free_pages"] == 24  # nothing pinned after release
+    finally:
+        server.close()
+
+
 def test_drain_during_chunked_prefill_serves_the_request(params):
     """A drain that begins while an admission's chunks are still landing
     must still serve that request (it was accepted — its slot is
