@@ -156,11 +156,10 @@ def test_pipeline_rejects_indivisible_batch():
 def test_config_validation():
     with pytest.raises(ValueError, match="divide"):
         dataclasses.replace(PP_CFG, n_layers=3).validate()
-    # pp x ring composes since round 3 — validate() must accept it;
-    # ulysses still cannot ride the pipeline's shard_map.
+    # pp x ring composes since round 3, pp x ulysses since round 4 —
+    # validate() must accept both.
     dataclasses.replace(PP_CFG, attention="ring").validate()
-    with pytest.raises(ValueError, match="ulysses"):
-        dataclasses.replace(PP_CFG, attention="ulysses").validate()
+    dataclasses.replace(PP_CFG, attention="ulysses").validate()
     with pytest.raises(ValueError, match="microbatches"):
         dataclasses.replace(PP_CFG, pipeline_microbatches=-2).validate()
     # pp x MoE composes since round 2 — validate() must accept it.
