@@ -321,6 +321,10 @@ _SERVE_WORKER = textwrap.dedent("""
     if jax.process_index() == 0:
         out = serve_fn({"tokens": [[3, 1, 4]], "n_new": 3})
         print("TOKENS " + json.dumps(out["tokens"]), flush=True)
+        sampled = serve_fn({"tokens": [[3, 1, 4]], "n_new": 3,
+                            "temperature": 0.8, "top_p": 0.9,
+                            "seed": 7})
+        print("SAMPLED " + json.dumps(sampled["tokens"]), flush=True)
         print(f"STEP {out['restored_step']}", flush=True)
         print(f"BACKEND {serve_fn.stats()['backend']}", flush=True)
         serve_fn.close()
@@ -418,6 +422,24 @@ def test_two_process_leader_serves_slice_trained_checkpoint(tmp_path):
             [so_far, nxt[:, None].astype(jnp.int32)], axis=1
         )
     np.testing.assert_array_equal(np.asarray(tokens), np.asarray(so_far))
+
+    # Sampled request across the slice: the leader and followers must
+    # fold the SAME canonicalized seed (the leader consumes the
+    # broadcast results), and the slice-wide sample must equal the
+    # single-host contiguous sampler with the identical key schedule.
+    from kvedge_tpu.models import generate
+
+    sampled = json_mod.loads(re.search(r"SAMPLED (.*)", leader_out).group(1))
+    base_key = jax.random.PRNGKey(7)
+    seed_keys = jax.vmap(
+        lambda i: jax.random.fold_in(base_key, i)
+    )(jnp.arange(1))
+    want = generate(
+        params, jnp.asarray([[3, 1, 4]], jnp.int32), tcfg, n_new=3,
+        sampling=(seed_keys, jnp.float32(0.8), jnp.float32(0.9)),
+        sampled=True,
+    )
+    np.testing.assert_array_equal(np.asarray(sampled), np.asarray(want))
 
 
 def test_two_process_train_survives_kill_and_matches_single(tmp_path):
