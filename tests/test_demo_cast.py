@@ -52,5 +52,7 @@ def test_cast_covers_the_end_to_end_story():
         "same tokens: True",            # speculative decode is exact
         '[model] preset = "flagship"',  # operator-sized payload model
         "41,558,528 params",            # ...at the bench shape, for real
+        "stream: true, shared prefix",  # paged serving: ndjson streaming
+        "tokens_saved=8",               # ...with prefix sharing live
     ):
         assert landmark in transcript, f"missing landmark: {landmark!r}"

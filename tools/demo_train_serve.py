@@ -96,6 +96,34 @@ def main() -> int:
           f"accepted_per_step={spec['accepted_per_step']}")
     print("serving the trained checkpoint: restored_step matches the "
           "training target")
+
+    if not flagship:
+        # The continuous-batching backend, on the same checkpoint:
+        # streamed tokens, device-side decode windows, chunked prefill,
+        # and prefix sharing between requests with a common prompt.
+        print("rebooting with [payload] serving = \"paged\" "
+              "(continuous batching)...")
+        check, paged_fn = run_serve_payload(dataclasses.replace(
+            base, payload="serve", payload_serving="paged",
+            serving_page_size=4, serving_prefill_chunk=4,
+        ))
+        if not check.ok:
+            print(f"paged serve payload failed: {check.error}")
+            return 1
+        shared = [5, 9, 2, 7, 1, 3, 3, 8]  # two full 4-token KV pages
+        first = paged_fn({"tokens": [shared + [4, 6]], "n_new": 4})
+        print(f"POST /generate (paged) -> tokens={first['tokens'][0]}")
+        streamed = paged_fn({"tokens": [shared + [2]], "n_new": 4,
+                             "stream": True})
+        docs = list(streamed["_stream"])
+        toks = [d["token"] for d in docs if "token" in d]
+        print(f"POST /generate (stream: true, shared prefix) -> "
+              f"tokens arrive one ndjson doc each: {toks}")
+        stats = paged_fn.stats()
+        print(f"prefix cache: hits={stats['prefix_hits']} "
+              f"tokens_saved={stats['prefix_tokens_saved']} "
+              f"(the second request prefilled only its suffix)")
+        paged_fn.close()
     return 0
 
 
