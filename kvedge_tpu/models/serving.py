@@ -578,107 +578,121 @@ class PagedGenerationServer:
         return out
 
     def _loop(self) -> None:
+        while True:
+            if self._loop_once() == "exit":
+                return
+            # Fair handoff: the loop would otherwise reacquire the lock
+            # immediately, and under CPython's GIL an admission waiter
+            # whose timeout already expired can lose that race at EVERY
+            # boundary while device steps hold the lock (lock convoy —
+            # observed as a waiter never getting to raise ServerBusy
+            # until the occupying request finished). One zero-sleep with
+            # the lock released yields the GIL so waiters can take it.
+            time.sleep(0)
+
+    def _loop_once(self) -> str:
+        """One decode-loop iteration under the lock ("exit" ends it)."""
         import jax.numpy as jnp
 
-        while True:
-            with self._work:
-                while (not self._active and not self._closed
-                       and not (self._draining
-                                and not self._prefilling)):
-                    self._work.wait()
-                if (self._draining and not self._active
-                        and not self._prefilling):
-                    # Drained: every accepted request — including any
-                    # whose chunked prefill was in flight when the
-                    # drain began — has finished.
-                    return
-                if self._closed:
-                    for req in self._active.values():
-                        req.error = ServerClosed("server shut down mid-"
-                                                 "request")
-                        if req.stream is not None:
-                            req.stream.put(req.error)
-                        req.done.set()
-                    self._active.clear()
-                    return
-                try:
-                    # Cancelled requests leave at this boundary: slot and
-                    # pages return to the pool, the waiter (if any) gets
-                    # RequestCancelled. Before the finish-check so a
-                    # cancel that raced budget completion still wins —
-                    # the consumer is gone either way.
-                    for slot in list(self._active):
-                        req = self._active[slot]
-                        if not req.cancelled:
-                            continue
-                        del self._active[slot]
-                        self._release_locked(slot, self._pages_for(req))
-                        req.error = RequestCancelled(
-                            "request cancelled mid-decode"
-                        )
-                        if req.stream is not None:
-                            req.stream.put(req.error)
-                        req.done.set()
-                    # A request whose pending token completes its budget
-                    # needs no step at all (the token is already known) —
-                    # finish it before the batch, the same discipline as
-                    # generate()'s n_new - 1 decode steps.
-                    for slot in list(self._active):
-                        req = self._active[slot]
-                        if len(req.generated) + 1 >= req.n_new:
-                            self._emit(req, req.next_token)
-                            del self._active[slot]
-                            self._release_locked(slot,
-                                                 self._pages_for(req))
-                            if req.stream is not None:
-                                req.stream.put(_STREAM_DONE)
-                            req.done.set()
-                    if not self._active:
+        with self._work:
+            while (not self._active and not self._closed
+                   and not (self._draining
+                            and not self._prefilling)):
+                self._work.wait()
+            if (self._draining and not self._active
+                    and not self._prefilling):
+                # Drained: every accepted request — including any
+                # whose chunked prefill was in flight when the
+                # drain began — has finished.
+                return "exit"
+            if self._closed:
+                for req in self._active.values():
+                    req.error = ServerClosed("server shut down mid-"
+                                             "request")
+                    if req.stream is not None:
+                        req.stream.put(req.error)
+                    req.done.set()
+                self._active.clear()
+                return "exit"
+            try:
+                # Cancelled requests leave at this boundary: slot and
+                # pages return to the pool, the waiter (if any) gets
+                # RequestCancelled. Before the finish-check so a
+                # cancel that raced budget completion still wins —
+                # the consumer is gone either way.
+                for slot in list(self._active):
+                    req = self._active[slot]
+                    if not req.cancelled:
                         continue
-                    # Feed every active slot's pending token through ONE
-                    # batched step; inactive slots carry zeros (masked).
-                    # The explicit mask (not "every admitted slot") is
-                    # what keeps interleaved chunked prefills safe: a
-                    # half-prefilled slot is admitted but NOT active.
-                    tokens = np.zeros((self._cache.slots,), np.int32)
-                    mask = np.zeros((self._cache.slots,), bool)
-                    for slot, req in self._active.items():
-                        tokens[slot] = req.next_token
-                        mask[slot] = True
-                    window = self._window_steps()
-                    if window > 1:
-                        # Device-side window: `window` greedy steps in
-                        # one dispatched scan (kvcache.step_window) —
-                        # the host pays one round trip per window, not
-                        # per token. Admission re-syncs between windows
-                        # (a submitter blocks on this lock until the
-                        # window returns, then joins the next one).
-                        produced = np.asarray(self._cache.step_window(
-                            self._params, jnp.asarray(tokens), window,
-                            active=mask,
-                        ))
-                        for slot, req in self._active.items():
-                            self._emit(req, req.next_token)
-                            for i in range(window - 1):
-                                self._emit(req, int(produced[i, slot]))
-                            req.next_token = int(produced[window - 1, slot])
-                        continue
-                    logits = self._cache.step(
-                        self._params, jnp.asarray(tokens), active=mask
+                    del self._active[slot]
+                    self._release_locked(slot, self._pages_for(req))
+                    req.error = RequestCancelled(
+                        "request cancelled mid-decode"
                     )
-                    next_tokens = self._next_tokens(logits)
+                    if req.stream is not None:
+                        req.stream.put(req.error)
+                    req.done.set()
+                # A request whose pending token completes its budget
+                # needs no step at all (the token is already known) —
+                # finish it before the batch, the same discipline as
+                # generate()'s n_new - 1 decode steps.
+                for slot in list(self._active):
+                    req = self._active[slot]
+                    if len(req.generated) + 1 >= req.n_new:
+                        self._emit(req, req.next_token)
+                        del self._active[slot]
+                        self._release_locked(slot,
+                                             self._pages_for(req))
+                        if req.stream is not None:
+                            req.stream.put(_STREAM_DONE)
+                        req.done.set()
+                if not self._active:
+                    return "ran"
+                # Feed every active slot's pending token through ONE
+                # batched step; inactive slots carry zeros (masked).
+                # The explicit mask (not "every admitted slot") is
+                # what keeps interleaved chunked prefills safe: a
+                # half-prefilled slot is admitted but NOT active.
+                tokens = np.zeros((self._cache.slots,), np.int32)
+                mask = np.zeros((self._cache.slots,), bool)
+                for slot, req in self._active.items():
+                    tokens[slot] = req.next_token
+                    mask[slot] = True
+                window = self._window_steps()
+                if window > 1:
+                    # Device-side window: `window` greedy steps in
+                    # one dispatched scan (kvcache.step_window) —
+                    # the host pays one round trip per window, not
+                    # per token. Admission re-syncs between windows
+                    # (a submitter blocks on this lock until the
+                    # window returns, then joins the next one).
+                    produced = np.asarray(self._cache.step_window(
+                        self._params, jnp.asarray(tokens), window,
+                        active=mask,
+                    ))
                     for slot, req in self._active.items():
                         self._emit(req, req.next_token)
-                        req.next_token = next_tokens[slot]
-                except Exception as e:  # poison: fail every waiter loudly
-                    for req in self._active.values():
-                        req.error = e
-                        if req.stream is not None:
-                            req.stream.put(e)
-                        req.done.set()
-                    self._active.clear()
-                    self._closed = True
-                    # Wake admission waiters so they fail fast with
-                    # ServerClosed instead of sleeping out their timeout.
-                    self._work.notify_all()
-                    return
+                        for i in range(window - 1):
+                            self._emit(req, int(produced[i, slot]))
+                        req.next_token = int(produced[window - 1, slot])
+                    return "ran"
+                logits = self._cache.step(
+                    self._params, jnp.asarray(tokens), active=mask
+                )
+                next_tokens = self._next_tokens(logits)
+                for slot, req in self._active.items():
+                    self._emit(req, req.next_token)
+                    req.next_token = next_tokens[slot]
+            except Exception as e:  # poison: fail every waiter loudly
+                for req in self._active.values():
+                    req.error = e
+                    if req.stream is not None:
+                        req.stream.put(e)
+                    req.done.set()
+                self._active.clear()
+                self._closed = True
+                # Wake admission waiters so they fail fast with
+                # ServerClosed instead of sleeping out their timeout.
+                self._work.notify_all()
+                return "exit"
+        return "ran"

@@ -282,10 +282,18 @@ def test_admission_control_rejects_impossible_and_times_out(params):
             # 50 + 14 = 64 positions = 4 pages > the 3-page pool
             server.submit([1] * 50, n_new=14)
         # Occupy the only slot, then a second submit must time out.
-        # The occupier's decode is artificially slowed (the windowed
-        # path finishes a warm 30-token budget in milliseconds — faster
-        # than any competitor timeout, so an unslowed occupier races).
+        # Two determinism measures: (a) the occupier's decode is
+        # artificially slowed — a warm 30-token budget finishes in
+        # milliseconds, faster than any competitor timeout; (b) every
+        # program the occupier needs is COMPILED FIRST by an identical
+        # request. Without the warmup, a loaded machine spends tens of
+        # seconds compiling the first window while the decode loop holds
+        # the lock — the competitor's expired wait can then only recheck
+        # at 2-3 widely-spaced window boundaries and can lose every
+        # lock race until the occupier finishes (observed flake).
         import time as time_mod
+
+        server.submit([9, 9, 9], n_new=30)  # compile prefill + windows
 
         real_window = server._cache.step_window
 
