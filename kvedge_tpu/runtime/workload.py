@@ -689,7 +689,8 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
             "multi-host serve supports the contiguous backend only: the "
             "paged server's admission/decode loop is host-side state on "
             "one process; drop [payload] serving = \"paged\" or deploy "
-            "serving single-host"
+            "serving single-host (cross-host continuous batching is "
+            "designed but not built — SERVING.md)"
         )
     if not cfg.checkpoint_dir:
         raise MeshConfigError(
