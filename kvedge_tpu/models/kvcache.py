@@ -80,18 +80,14 @@ class PagedKVCache:
         warn_if_train_serve_divergence(cfg)
         self.cfg = cfg
         self.slots = slots
+        self.num_pages = pages
         self.page_size = page_size
         self.max_pages_per_seq = (
             max_pages_per_seq or -(-cfg.max_seq // page_size)
         )
         dtype = jnp.dtype(cfg.dtype)
         shape = (cfg.n_layers, pages, page_size, cfg.kv_heads, cfg.d_head)
-        self.state = PagedState(
-            pool_k=jnp.zeros(shape, dtype),
-            pool_v=jnp.zeros(shape, dtype),
-            tables=jnp.zeros((slots, self.max_pages_per_seq), jnp.int32),
-            lengths=jnp.zeros((slots,), jnp.int32),
-        )
+        self.state = self._init_state(shape, dtype)
         self._free: list[int] = list(range(pages))[::-1]  # pop() -> lowest last
         self._pages_of: dict[int, list[int]] = {}
         self._host_tables = [
@@ -109,6 +105,19 @@ class PagedKVCache:
         # finds the free list short asks the owner to reclaim pins
         # before failing. Signature: pressure_relief(needed) -> bool.
         self.pressure_relief = None
+
+    def _init_state(self, shape, dtype) -> PagedState:
+        """Fresh zeroed device state. The slice-serving subclass
+        (runtime/sliceserve.py) overrides this to create GLOBAL arrays
+        over a multi-host mesh; everything above is host bookkeeping
+        that neither knows nor cares where the pools live."""
+        return PagedState(
+            pool_k=jnp.zeros(shape, dtype),
+            pool_v=jnp.zeros(shape, dtype),
+            tables=jnp.zeros((self.slots, self.max_pages_per_seq),
+                             jnp.int32),
+            lengths=jnp.zeros((self.slots,), jnp.int32),
+        )
 
     # ---- control plane (host) -------------------------------------------
 
@@ -281,6 +290,10 @@ class PagedKVCache:
                 f"chunk [{offset}, {offset + n}) exceeds slot {slot}'s "
                 f"admitted length {self._host_lengths[slot]}"
             )
+        return self._device_prefill(params, tokens, slot, offset)
+
+    def _device_prefill(self, params, tokens, slot: int, offset: int):
+        """Device seam: run the prefill kernel and advance state."""
         logits, self.state = _paged_prefill(
             params, self.state, tokens, slot, self.cfg, offset
         )
@@ -320,15 +333,20 @@ class PagedKVCache:
             # Device tables are stale only when a page was allocated; the
             # steady-state token step pays no host->device re-upload.
             self._sync()
-        logits, self.state = _paged_decode_step(
-            params, self.state, tokens, self.cfg,
-            self._active_array(self.state, active),
-        )
+        logits = self._device_step(params, tokens, active)
         # The device state already advanced active slots' lengths (the
         # active mask in _paged_decode_step); just mirror on the host —
         # tables only change in admit/grow/release, which sync themselves.
         for slot in slots:
             self._host_lengths[slot] += 1
+        return logits
+
+    def _device_step(self, params, tokens, active):
+        """Device seam: one batched decode step over current state."""
+        logits, self.state = _paged_decode_step(
+            params, self.state, tokens, self.cfg,
+            self._active_array(self.state, active),
+        )
         return logits
 
     def step_window(self, params, tokens, n_steps: int, active=None):
@@ -355,12 +373,17 @@ class PagedKVCache:
             grew |= self.grow_to(slot, n_steps)
         if grew:
             self._sync()
+        toks = self._device_window(params, tokens, n_steps, active)
+        for slot in slots:
+            self._host_lengths[slot] += n_steps
+        return toks
+
+    def _device_window(self, params, tokens, n_steps: int, active):
+        """Device seam: ``n_steps`` greedy steps in one program."""
         toks, self.state = _paged_decode_window(
             params, self.state, tokens, self.cfg, n_steps,
             self._active_array(self.state, active),
         )
-        for slot in slots:
-            self._host_lengths[slot] += n_steps
         return toks
 
 
@@ -487,9 +510,8 @@ def _run_paged(cfg, params, state, x, q_positions, slot=None):
     return logits, new_k, new_v
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
-def _paged_prefill(params: dict, state: PagedState, prompt, slot,
-                   cfg: TransformerConfig, offset=0):
+def _paged_prefill_impl(params: dict, state: PagedState, prompt, slot,
+                        cfg: TransformerConfig, offset=0):
     # ``slot`` and ``offset`` are traced (they are only ever indices),
     # so XLA compiles one program per CHUNK length, not one per
     # (slot, offset, length) triple.
@@ -500,6 +522,11 @@ def _paged_prefill(params: dict, state: PagedState, prompt, slot,
         cfg, params, state, x, q_positions, slot
     )
     return logits[0], dataclasses.replace(state, pool_k=new_k, pool_v=new_v)
+
+
+_paged_prefill = functools.partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(1,)
+)(_paged_prefill_impl)
 
 
 def _decode_step_core(params: dict, state: PagedState, tokens,
@@ -526,16 +553,14 @@ def _decode_step_core(params: dict, state: PagedState, tokens,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
-def _paged_decode_step(params: dict, state: PagedState, tokens,
-                       cfg: TransformerConfig, active):
-    return _decode_step_core(params, state, tokens, cfg, active)
+_paged_decode_step = functools.partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(1,)
+)(_decode_step_core)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n_steps"),
-                   donate_argnums=(1,))
-def _paged_decode_window(params: dict, state: PagedState, tokens,
-                         cfg: TransformerConfig, n_steps: int, active):
+def _paged_decode_window_impl(params: dict, state: PagedState, tokens,
+                              cfg: TransformerConfig, n_steps: int,
+                              active):
     """``n_steps`` decode steps with greedy feedback, one program.
 
     The scan carries (state, pending token); each step feeds the pending
@@ -552,3 +577,8 @@ def _paged_decode_window(params: dict, state: PagedState, tokens,
         body, (state, tokens), length=n_steps
     )
     return produced, state
+
+
+_paged_decode_window = functools.partial(
+    jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(1,)
+)(_paged_decode_window_impl)

@@ -134,7 +134,8 @@ class PagedGenerationServer:
 
     def __init__(self, params: dict, cfg, *, slots: int = 4,
                  pages: int = 64, page_size: int = 16,
-                 prefill_chunk: int = 0, prefix_cache: bool = True):
+                 prefill_chunk: int = 0, prefix_cache: bool = True,
+                 cache=None):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
@@ -144,7 +145,16 @@ class PagedGenerationServer:
         # in-flight requests keep decoding during an admission and XLA
         # compiles per chunk length instead of per prompt length.
         self._prefill_chunk = prefill_chunk
-        self._cache = PagedKVCache(
+        # An injected cache overrides the pool knobs: the multi-host
+        # serve path hands in a SlicePagedKVCache whose device calls
+        # span the slice (runtime/sliceserve.py); the server neither
+        # knows nor cares — every cache call below already serializes
+        # on the one lock, which is exactly the total-order guarantee
+        # the slice protocol needs.
+        if cache is not None:
+            slots, pages = cache.slots, cache.num_pages
+            page_size = cache.page_size
+        self._cache = cache or PagedKVCache(
             cfg, slots=slots, pages=pages, page_size=page_size
         )
         # Prefix sharing: completed prompts register their page-aligned
@@ -479,6 +489,19 @@ class PagedGenerationServer:
             with self._work:
                 self._closed = True
                 self._work.notify_all()
+        # A slice-aware cache (runtime/sliceserve.py) releases its
+        # followers here — under the lock, so the stop op serializes
+        # AFTER any in-flight request thread's cache call (a hard close
+        # can race a chunked prefill whose error path still releases its
+        # slot) and the cache's idempotence flag is check-then-act
+        # atomic. Single-host caches define no stop. A decode thread
+        # that outlived its join timeout may be wedged in a collective
+        # HOLDING the lock (dead follower) — skip the release rather
+        # than hang close() too; that slice is already lost.
+        stop = getattr(self._cache, "stop", None)
+        if stop is not None and not self._thread.is_alive():
+            with self._work:
+                stop()
 
     def stats(self) -> dict:
         with self._lock:

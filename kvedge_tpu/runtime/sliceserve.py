@@ -1,0 +1,311 @@
+"""Cross-host continuous batching: the paged scheduler on a multi-host slice.
+
+The design of record from SERVING.md ("Left on the table" — now built):
+the control plane is NOT distributed. Admission, slot assignment, block
+tables, reservations, and the prefix trie stay host metadata on the
+leader (process 0), exactly as they are single-host; followers only ever
+execute the *device program* with the leader's inputs. Concretely, a
+:class:`SlicePagedKVCache` on the leader broadcasts each device call —
+table sync, prefill chunk, decode step, decode window — as a fixed-shape
+header plus its inputs, then every process executes the SAME jitted
+kernel on global arrays, so XLA's collectives span the slice exactly as
+they do in multi-host training. The follower side is
+:func:`follow_paged`: a loop that receives ops and replays them.
+
+Why this is sound:
+
+* **Total order.** Every cache-state mutation in the serving layer
+  serializes on the server lock (SERVING.md invariant 5), so the
+  leader's broadcasts form one totally-ordered op stream; the follower
+  replays it in order. There is no second broadcaster by construction.
+* **Followers hold no host state.** Free lists, refcounts, LRU stamps,
+  reservations — none of it is replicated (the LRU clock isn't even
+  deterministic across hosts). The follower's device state evolves
+  identically because the device inputs — tables, lengths, tokens,
+  masks — arrive by value in the op stream.
+* **Windows amortize the broadcast like they amortize RTT.** Between
+  page boundaries the decode loop dispatches one WINDOW op per
+  ``page_size`` greedy tokens; the cross-host control traffic rides the
+  same cadence as the single-host loop's host reads.
+* **Failure is slice-fatal, by policy.** A follower that dies leaves
+  the leader blocked in a collective — the same contract as multi-host
+  training, and the chart's StatefulSet restarts the slice (SERVING.md
+  names rejoin-at-a-boundary as the alternative and why it isn't
+  worth the state-machine complexity at this scale).
+
+The reference has no serving and no multi-host anything (SURVEY.md §0,
+§5); this module is the last rung of the serving ladder this repo
+climbs on top of the reference's deployment story.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kvedge_tpu.models.kvcache import (
+    PagedCacheError,
+    PagedKVCache,
+    PagedState,
+    _decode_step_core,
+    _paged_decode_window_impl,
+    _paged_prefill_impl,
+)
+
+# Op codes (header[0]). STOP ends the follower loop.
+OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW = range(5)
+_HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
+
+
+def _slice_kernels(mesh, cfg):
+    """The paged kernels re-jitted with pinned output shardings: the
+    K/V pools shard over the ``model`` axis on the kv-heads dim (the
+    per-token K/V a model-sharded layer produces is already
+    head-sharded, so scatters stay local and no host ever materializes
+    the whole pool), falling back to replication when the heads don't
+    divide; logits/tokens/tables pin REPLICATED so each process reads
+    them from its own addressable shard (``addressable_data(0)``) with
+    no extra collective. Compiled programs are the single-host impl
+    functions unchanged — the exactness argument is structural, not
+    re-proven."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    model = axis_sizes.get("model", 1)
+    pool_sh = (
+        NamedSharding(mesh, P(None, None, None, "model", None))
+        if model > 1 and cfg.kv_heads % model == 0 else rep
+    )
+    state_sh = PagedState(
+        pool_k=pool_sh, pool_v=pool_sh, tables=rep, lengths=rep
+    )
+    prefill = jax.jit(
+        _paged_prefill_impl, static_argnames=("cfg",),
+        donate_argnums=(1,), out_shardings=(rep, state_sh),
+    )
+    step = jax.jit(
+        _decode_step_core, static_argnames=("cfg",),
+        donate_argnums=(1,), out_shardings=(rep, state_sh),
+    )
+    window = jax.jit(
+        _paged_decode_window_impl, static_argnames=("cfg", "n_steps"),
+        donate_argnums=(1,), out_shardings=(rep, state_sh),
+    )
+    return rep, state_sh, prefill, step, window
+
+
+class SlicePagedKVCache(PagedKVCache):
+    """A :class:`PagedKVCache` whose device calls span a multi-host mesh.
+
+    Constructed identically on EVERY process (the zeroed global state
+    and the jitted kernels are collective creations, so construction
+    order is part of the protocol). On the leader it is handed to a
+    regular :class:`~kvedge_tpu.models.serving.PagedGenerationServer`
+    and behaves like any cache — all the host bookkeeping of the base
+    class runs as-is; only the device seams broadcast first. On
+    followers, :func:`follow_paged` drives :meth:`_follow_op` until the
+    leader broadcasts STOP.
+
+    Single-process meshes work too (broadcast_one_to_all degenerates to
+    a copy), which is how tests/test_sliceserve.py pins leader-path
+    token equality against the plain cache without subprocesses.
+    """
+
+    def __init__(self, cfg, *, slots: int, pages: int, page_size: int,
+                 mesh, max_pages_per_seq: int | None = None):
+        import jax
+
+        self.mesh = mesh
+        (self._rep, self._state_sh, self._k_prefill, self._k_step,
+         self._k_window) = _slice_kernels(mesh, cfg)
+        self._is_leader = jax.process_index() == 0
+        self._stopped = False
+        super().__init__(
+            cfg, slots=slots, pages=pages, page_size=page_size,
+            max_pages_per_seq=max_pages_per_seq,
+        )
+
+    # ---- global-array plumbing ------------------------------------------
+
+    def _init_state(self, shape, dtype) -> PagedState:
+        """Zeroed state as GLOBAL arrays: a collective jit execution
+        (every process runs it at construction)."""
+        import jax
+        import jax.numpy as jnp
+
+        slots, mpps = self.slots, self.max_pages_per_seq
+        return jax.jit(
+            lambda: PagedState(
+                pool_k=jnp.zeros(shape, dtype),
+                pool_v=jnp.zeros(shape, dtype),
+                tables=jnp.zeros((slots, mpps), jnp.int32),
+                lengths=jnp.zeros((slots,), jnp.int32),
+            ),
+            out_shardings=self._state_sh,
+        )()
+
+    def _global(self, arr: np.ndarray):
+        """A replicated global array from identical per-process data."""
+        import jax
+
+        return jax.make_array_from_process_local_data(self._rep, arr)
+
+    @staticmethod
+    def _read(arr) -> np.ndarray:
+        """Host copy of a replicated global array (local shard only)."""
+        return np.asarray(arr.addressable_data(0))
+
+    def _bcast(self, tree):
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(
+            tree, is_source=self._is_leader
+        )
+
+    def _send_header(self, op: int, a: int = 0, b: int = 0, c: int = 0):
+        hdr = np.array([op, a, b, c], np.int64)
+        self._bcast(hdr)
+
+    # ---- leader-side device seams (base-class host logic unchanged) -----
+
+    def _sync(self) -> None:
+        if self._stopped:
+            # Teardown tail: a request thread unwinding after a hard
+            # close still releases its slot, which syncs tables — the
+            # followers are gone, the device state is dead, so the
+            # host bookkeeping proceeds without a broadcast.
+            return
+        tables = np.asarray(self._host_tables, np.int32)
+        lengths = np.asarray(self._host_lengths, np.int32)
+        self._send_header(OP_SYNC)
+        tables, lengths = self._bcast((tables, lengths))
+        self._apply_sync(np.asarray(tables), np.asarray(lengths))
+
+    def _apply_sync(self, tables: np.ndarray, lengths: np.ndarray):
+        import dataclasses
+
+        self.state = dataclasses.replace(
+            self.state,
+            tables=self._global(tables.astype(np.int32)),
+            lengths=self._global(lengths.astype(np.int32)),
+        )
+
+    def _check_live(self) -> None:
+        if self._stopped:
+            raise PagedCacheError(
+                "slice serve is stopped — the followers were released"
+            )
+
+    def _device_prefill(self, params, tokens, slot: int, offset: int):
+        self._check_live()
+        tokens = np.asarray(tokens, np.int32)
+        self._send_header(OP_PREFILL, slot, offset, tokens.shape[0])
+        tokens = np.asarray(self._bcast(tokens))
+        return self._exec_prefill(params, tokens, slot, offset)
+
+    def _exec_prefill(self, params, tokens: np.ndarray, slot: int,
+                      offset: int):
+        logits, self.state = self._k_prefill(
+            params, self.state, self._global(tokens.astype(np.int32)),
+            slot, self.cfg, offset,
+        )
+        return self._read(logits)
+
+    def _active_np(self, active) -> np.ndarray:
+        """bool [slots] mask on the HOST — the base class derives the
+        default (None = every admitted slot) from device lengths, which
+        a leader-only computation must not touch on a global array."""
+        if active is None:
+            return np.asarray(self._host_lengths, np.int64) > 0
+        return np.asarray(active, bool)
+
+    def _device_step(self, params, tokens, active):
+        self._check_live()
+        tokens = np.asarray(tokens, np.int32)
+        self._send_header(OP_STEP)
+        tokens, mask = self._bcast((tokens, self._active_np(active)))
+        return self._exec_step(params, np.asarray(tokens),
+                               np.asarray(mask))
+
+    def _exec_step(self, params, tokens: np.ndarray, mask: np.ndarray):
+        logits, self.state = self._k_step(
+            params, self.state, self._global(tokens.astype(np.int32)),
+            self.cfg, self._global(mask.astype(bool)),
+        )
+        return self._read(logits)
+
+    def _device_window(self, params, tokens, n_steps: int, active):
+        self._check_live()
+        tokens = np.asarray(tokens, np.int32)
+        self._send_header(OP_WINDOW, n_steps)
+        tokens, mask = self._bcast((tokens, self._active_np(active)))
+        return self._exec_window(params, np.asarray(tokens),
+                                 np.asarray(mask), n_steps)
+
+    def _exec_window(self, params, tokens: np.ndarray, mask: np.ndarray,
+                     n_steps: int):
+        toks, self.state = self._k_window(
+            params, self.state, self._global(tokens.astype(np.int32)),
+            self.cfg, n_steps, self._global(mask.astype(bool)),
+        )
+        return self._read(toks)
+
+    def stop(self) -> None:
+        """Leader: release the followers (end of serve). Idempotent —
+        the serving layer calls this from ``close()`` UNDER the server
+        lock (after the decode loop has exited), which serializes it
+        after any in-flight request thread's cache call and makes the
+        flag check atomic; a second STOP would be a collective the
+        departed followers never join. After stop, table syncs become
+        local no-ops (teardown still releases slots) and device ops
+        refuse loudly."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._send_header(OP_STOP)
+
+    # ---- follower side ---------------------------------------------------
+
+    def _follow_op(self, params) -> bool:
+        """Receive and replay one op. Returns False on STOP."""
+        hdr = np.asarray(self._bcast(np.zeros(_HEADER_LEN, np.int64)))
+        op, a, b, c = (int(v) for v in hdr)
+        if op == OP_STOP:
+            return False
+        if op == OP_SYNC:
+            tables, lengths = self._bcast((
+                np.zeros((self.slots, self.max_pages_per_seq), np.int32),
+                np.zeros((self.slots,), np.int32),
+            ))
+            self._apply_sync(np.asarray(tables), np.asarray(lengths))
+        elif op == OP_PREFILL:
+            tokens = self._bcast(np.zeros((c,), np.int32))
+            self._exec_prefill(params, np.asarray(tokens), a, b)
+        elif op == OP_STEP:
+            tokens, mask = self._bcast((
+                np.zeros((self.slots,), np.int32),
+                np.zeros((self.slots,), bool),
+            ))
+            self._exec_step(params, np.asarray(tokens), np.asarray(mask))
+        elif op == OP_WINDOW:
+            tokens, mask = self._bcast((
+                np.zeros((self.slots,), np.int32),
+                np.zeros((self.slots,), bool),
+            ))
+            self._exec_window(params, np.asarray(tokens),
+                              np.asarray(mask), a)
+        else:  # pragma: no cover - protocol corruption is slice-fatal
+            raise PagedCacheError(f"unknown slice-serve op {op}")
+        return True
+
+
+def follow_paged(cache: SlicePagedKVCache, params) -> None:
+    """Follower loop: replay the leader's op stream until STOP.
+
+    Any exception here is slice-fatal (the leader will block in its
+    next collective); the caller logs and lets the pod die — the
+    StatefulSet restart IS the recovery path, same as training.
+    """
+    while cache._follow_op(params):
+        pass

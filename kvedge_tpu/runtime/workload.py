@@ -667,9 +667,10 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
     coordinator), so "HTTP hits process 0" is the deployment's natural
     shape, not an extra router.
 
-    Contiguous backend only: the paged server's admission/decode loop is
-    per-process host state; a cross-host continuous-batching scheduler
-    is a different design (refused loudly below).
+    Paged backend: the continuous-batching scheduler stays leader-only
+    host state; its DEVICE calls broadcast to the slice via
+    ``SlicePagedKVCache`` (runtime/sliceserve.py) — see
+    :func:`_run_multihost_paged_serve`.
     """
     import dataclasses
     import threading
@@ -684,14 +685,6 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
     from kvedge_tpu.models import generate
     from kvedge_tpu.runtime.status import GenerateUnavailable
 
-    if cfg.payload_serving == "paged":
-        raise MeshConfigError(
-            "multi-host serve supports the contiguous backend only: the "
-            "paged server's admission/decode loop is host-side state on "
-            "one process; drop [payload] serving = \"paged\" or deploy "
-            "serving single-host (cross-host continuous batching is "
-            "designed but not built — SERVING.md)"
-        )
     if not cfg.checkpoint_dir:
         raise MeshConfigError(
             "multi-host serve needs [runtime] checkpoint_dir on shared "
@@ -699,6 +692,10 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
             "(README 'Multi-host')"
         )
     restored_step, params = _restore_latest_params(cfg, tcfg, mesh=mesh)
+    if cfg.payload_serving == "paged":
+        return _run_multihost_paged_serve(
+            cfg, base, tcfg, mesh, restored_step, params
+        )
     leader = jax.process_index() == 0
     replicated = NamedSharding(mesh, P())
     max_rows = 4 * cfg.serving_slots
@@ -751,8 +748,14 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
                     tokens_np = bcast(np.zeros((rows, plen), np.int32))
                     run_request(ints, floats, tokens_np)
             except Exception as e:  # pragma: no cover - slice-fatal
+                # Same contract as the paged follower: die loudly so
+                # the StatefulSet restarts the slice instead of leaving
+                # a healthy-looking pod the leader can never reach.
                 print(f"[kvedge-serve] follower loop died: {e!r}",
                       flush=True)
+                import os as os_mod
+
+                os_mod._exit(13)
 
         thread = threading.Thread(target=follow,
                                   name="kvedge-serve-follow", daemon=True)
@@ -863,6 +866,99 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
         base, probe_ms=elapsed_ms,
         probe_checksum=float(sum(probe["tokens"][0])),
     ), serve_fn
+
+
+def _serving_pool_dims(cfg, tcfg) -> tuple[int, int, int]:
+    """``(slots, pages, page_size)`` of the paged pool — ONE derivation
+    for the single-host server and the slice cache (the two must never
+    size differently). ``serving_pages = 0`` auto-sizes so every slot
+    can hold a worst-case request — admission then only ever waits on
+    slots, never on pages."""
+    slots, page_size = cfg.serving_slots, cfg.serving_page_size
+    pages = cfg.serving_pages or slots * -(-tcfg.max_seq // page_size)
+    return slots, pages, page_size
+
+
+def _run_multihost_paged_serve(cfg, base, tcfg, mesh, restored_step,
+                               params):
+    """Cross-host continuous batching: the paged scheduler on a slice.
+
+    The leader runs the UNMODIFIED single-host serving stack —
+    ``PagedGenerationServer`` with all its admission, chunked prefill,
+    prefix sharing, cancellation, and windowing — over a
+    ``SlicePagedKVCache`` whose device seams broadcast each op so every
+    process executes the same jitted kernel on global arrays
+    (runtime/sliceserve.py has the protocol and its soundness
+    argument). Followers replay the op stream; their own /generate
+    answers 503 pointing at the leader, exactly like the contiguous
+    leader-serves path. Sampling stays leader-local (only the CHOSEN
+    tokens enter the op stream), so the cross-backend key schedule
+    holds without broadcasting seeds.
+    """
+    import dataclasses
+    import threading
+
+    import jax
+
+    from kvedge_tpu.runtime.sliceserve import (
+        SlicePagedKVCache,
+        follow_paged,
+    )
+    from kvedge_tpu.runtime.status import GenerateUnavailable
+
+    # Constructed identically on EVERY process, at the same point in
+    # the collective order (the zeroed global pool is a collective jit
+    # execution).
+    slots, pages, page_size = _serving_pool_dims(cfg, tcfg)
+    cache = SlicePagedKVCache(
+        tcfg, slots=slots, pages=pages, page_size=page_size, mesh=mesh,
+    )
+
+    if jax.process_index() != 0:
+        def follow():
+            try:
+                follow_paged(cache, params)
+            except Exception as e:  # pragma: no cover - slice-fatal
+                # Slice-fatal MEANS the pod dies: a swallowed replay
+                # failure would leave this pod answering /healthz while
+                # the leader wedges in a collective forever. Exiting
+                # non-zero makes the StatefulSet restart the slice —
+                # the recovery path SERVING.md commits to.
+                print(f"[kvedge-serve] paged follower died: {e!r}",
+                      flush=True)
+                import os as os_mod
+
+                os_mod._exit(13)
+
+        thread = threading.Thread(
+            target=follow, name="kvedge-serve-follow", daemon=True
+        )
+        thread.start()
+
+        def follower_fn(doc: dict) -> dict:
+            raise GenerateUnavailable(
+                f"this pod is follower process {jax.process_index()}; "
+                "generation is served by the leader (process 0 — the "
+                "Service routes to ordinal 0)"
+            )
+
+        follower_fn.stats = lambda: {
+            "backend": "multihost-paged-follower",
+            "processes": jax.process_count(),
+        }
+        follower_fn.close = lambda drain=False: None
+        follower_fn.join = thread.join
+        return dataclasses.replace(
+            base, probe_ms=0.0, probe_checksum=0.0,
+        ), follower_fn
+
+    # Follower release rides the server's own close: PagedGenerationServer
+    # calls cache.stop() under its lock after the decode loop exits —
+    # serialized after every in-flight cache call, and idempotent.
+    return _build_serve(
+        cfg, base, tcfg, params, restored_step, cache=cache,
+        backend="multihost-paged",
+    )
 
 
 def _parse_generate_request(doc: dict, tcfg, *, max_rows: int,
@@ -1017,13 +1113,8 @@ def run_serve_payload(cfg: RuntimeConfig):
         return base, None
 
     import dataclasses
-    import threading
-    import time as time_mod
 
     import jax
-    import jax.numpy as jnp
-
-    from kvedge_tpu.models import generate
 
     try:
         tcfg, mesh = train_model_config(cfg)
@@ -1039,29 +1130,58 @@ def run_serve_payload(cfg: RuntimeConfig):
         # runs under jit with the input shardings driving XLA's SPMD
         # partitioner, exactly like the train step.
         restored_step, params = _restore_latest_params(cfg, tcfg, mesh=mesh)
+        return _build_serve(cfg, base, tcfg, params, restored_step)
+    except MeshConfigError as e:
+        # Raised before any server/device state exists: surface the
+        # operator-facing config message, not a wrapped traceback.
+        return dataclasses.replace(base, ok=False, error=str(e)), None
+    except Exception as e:
+        return dataclasses.replace(
+            base, ok=False, error=f"serve payload failed: {e!r}",
+        ), None
 
-        # Row ceiling + worker pool sized from the serving knobs: the
-        # serve path must not spawn one thread per row (VERDICT r3 #6 —
-        # a burst of wide requests was an unbounded thread surface).
-        max_rows = 4 * cfg.serving_slots
-        row_pool = None
-        paged_server = None
-        if cfg.payload_serving == "paged":
+
+def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
+                 backend=None):
+    """Build the serve endpoint over restored ``params``.
+
+    The ONE construction of the serving data path, shared by the
+    single-host payload (``cache=None`` — it builds its own pool from
+    the ``[payload] serving_*`` knobs) and the multi-host paged leader
+    (``cache`` = a ``SlicePagedKVCache`` whose device calls span the
+    slice; ``backend`` labels the stats). Returns
+    ``(DeviceCheckResult, serve_fn)``; on failure, tears down anything
+    it created and re-raises for the caller's error mapping.
+    """
+    import dataclasses
+    import threading
+    import time as time_mod
+
+    import jax
+    import jax.numpy as jnp
+
+    from kvedge_tpu.models import generate
+
+    # Row ceiling + worker pool sized from the serving knobs: the
+    # serve path must not spawn one thread per row (VERDICT r3 #6 —
+    # a burst of wide requests was an unbounded thread surface).
+    max_rows = 4 * cfg.serving_slots
+    row_pool = None
+    paged_server = None
+    try:
+        if cache is not None or cfg.payload_serving == "paged":
             from kvedge_tpu.models.serving import PagedGenerationServer
 
-            # Pool sized from the [payload] serving_* knobs; pages = 0
-            # auto-sizes so every slot can hold a worst-case request —
-            # admission then only ever waits on slots, never on pages.
             # page_size passed explicitly so the sizing arithmetic and
-            # the cache's pages can never drift apart.
-            slots, page_size = cfg.serving_slots, cfg.serving_page_size
-            pages = (cfg.serving_pages
-                     or slots * -(-tcfg.max_seq // page_size))
+            # the cache's pages can never drift apart; an injected
+            # cache carries its own pool from the SAME derivation.
+            slots, pages, page_size = _serving_pool_dims(cfg, tcfg)
             paged_server = PagedGenerationServer(
                 params, tcfg, slots=slots, pages=pages,
                 page_size=page_size,
                 prefill_chunk=cfg.serving_prefill_chunk,
                 prefix_cache=cfg.serving_prefix_cache,
+                cache=cache,
             )
             # One shared pool for row priming AND stream pumping, sized
             # 2x slots (only `slots` rows decode concurrently; one
@@ -1334,8 +1454,11 @@ def run_serve_payload(cfg: RuntimeConfig):
 
         def serve_stats() -> dict:
             out = counters.snapshot()
-            out["backend"] = ("paged" if paged_server is not None
-                              else "contiguous")
+            out["backend"] = backend or (
+                "paged" if paged_server is not None else "contiguous"
+            )
+            if backend is not None:
+                out["processes"] = jax.process_count()
             if paged_server is not None:
                 # Pool occupancy straight from the server (in_flight,
                 # free_slots, free_pages, reserved_pages).
@@ -1379,26 +1502,19 @@ def run_serve_payload(cfg: RuntimeConfig):
                 row_pool.shutdown(wait=drain, cancel_futures=not drain)
 
         serve_fn.close = _close
-    except MeshConfigError as e:
-        # Raised before any server/device state exists: surface the
-        # operator-facing config message, not a wrapped traceback.
-        return dataclasses.replace(base, ok=False, error=str(e)), None
-    except Exception as e:
-        if cfg.payload_serving == "paged":
-            try:
-                if paged_server is not None:
-                    paged_server.close()
-                if row_pool is not None:
-                    row_pool.shutdown(wait=False, cancel_futures=True)
-            except (NameError, UnboundLocalError):
-                pass  # failed before the variable existed
         return dataclasses.replace(
-            base, ok=False, error=f"serve payload failed: {e!r}",
-        ), None
-    return dataclasses.replace(
-        base, probe_ms=elapsed_ms,
-        probe_checksum=float(sum(probe["tokens"][0])),
-    ), serve_fn
+            base, probe_ms=elapsed_ms,
+            probe_checksum=float(sum(probe["tokens"][0])),
+        ), serve_fn
+    except Exception:
+        # paged_server.close() also releases a slice cache's followers
+        # (the cache.stop hook); if the failure desynced the broadcast
+        # stream the slice is already lost (restart path).
+        if paged_server is not None:
+            paged_server.close()
+        if row_pool is not None:
+            row_pool.shutdown(wait=False, cancel_futures=True)
+        raise
 
 
 # Inference probe: small GQA model, short prompt, a few greedy steps.

@@ -216,7 +216,8 @@ _TRAIN_WORKER = textwrap.dedent("""
 """)
 
 
-def _train_toml(tmp_path, *, num_processes, steps, state_dir, port):
+def _train_toml(tmp_path, *, num_processes, steps, state_dir, port,
+                serving=""):
     corpus = tmp_path / "corpus.kvfeed"
     if not corpus.exists():
         import numpy as np
@@ -246,6 +247,7 @@ def _train_toml(tmp_path, *, num_processes, steps, state_dir, port):
         "batch = 8\n"
         "seq = 32\n"
         "checkpoint_every = 2\n"
+        + (f'serving = "{serving}"\n' if serving else "")
     )
 
 
@@ -440,6 +442,169 @@ def test_two_process_leader_serves_slice_trained_checkpoint(tmp_path):
         sampled=True,
     )
     np.testing.assert_array_equal(np.asarray(sampled), np.asarray(want))
+
+
+# ---- Multi-host serving: cross-host continuous batching (round 4) --------
+#
+# The paged scheduler on a 2-process slice: the leader runs the full
+# single-host serving stack (admission, chunked prefill, prefix trie,
+# windows, streaming, sampling) over a SlicePagedKVCache that broadcasts
+# each device op; the follower replays the op stream
+# (runtime/sliceserve.py). Tokens must equal the single-host contiguous
+# decode of the same slice-trained checkpoint — the same exactness bar
+# every other serving backend meets.
+
+_PAGED_SERVE_WORKER = textwrap.dedent("""
+    import dataclasses, json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from kvedge_tpu.config.runtime_config import RuntimeConfig
+    from kvedge_tpu.parallel.distributed import maybe_initialize
+    from kvedge_tpu.runtime.workload import (
+        run_serve_payload, run_train_payload,
+    )
+
+    cfg = RuntimeConfig.parse(open(os.environ["KVEDGE_SERVE_TOML"]).read())
+    maybe_initialize(cfg.distributed, environ=os.environ,
+                     hostname=os.environ["FAKE_POD_NAME"])
+    tr = run_train_payload(cfg)
+    if not tr.ok:
+        print(f"TRAINFAIL {tr.error!r}", flush=True)
+        sys.exit(1)
+    check, serve_fn = run_serve_payload(
+        dataclasses.replace(cfg, payload="serve")
+    )
+    print(f"SERVE ok={check.ok} err={check.error!r}", flush=True)
+    if not check.ok:
+        sys.exit(1)
+    if jax.process_index() == 0:
+        out = serve_fn({"tokens": [[3, 1, 4], [2, 7, 1]], "n_new": 8})
+        print("TOKENS " + json.dumps(out["tokens"]), flush=True)
+        sampled = serve_fn({"tokens": [[3, 1, 4]], "n_new": 3,
+                            "temperature": 0.8, "top_p": 0.9,
+                            "seed": 7})
+        print("SAMPLED " + json.dumps(sampled["tokens"]), flush=True)
+        res = serve_fn({"tokens": [[5, 2, 6]], "n_new": 6,
+                        "stream": True})
+        final = None
+        for item in res["_stream"]:
+            if "done" in item:
+                final = item
+        print("STREAMED " + json.dumps(final["tokens"]), flush=True)
+        print(f"BACKEND {serve_fn.stats()['backend']}", flush=True)
+        serve_fn.close(drain=True)
+    else:
+        try:
+            serve_fn({"tokens": [[1, 2]], "n_new": 1})
+            print("FOLLOWER-ANSWERED (should have 503d)", flush=True)
+            sys.exit(1)
+        except Exception as e:
+            print(f"FOLLOWER503 {type(e).__name__}", flush=True)
+        serve_fn.join(timeout=240)
+    sys.exit(0)
+""")
+
+
+def test_two_process_paged_serve_slice_trained_checkpoint(tmp_path):
+    import json as json_mod
+    import re
+
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        toml_path = tmp_path / f"serve-{pid}.toml"
+        toml_path.write_text(_train_toml(
+            tmp_path, num_processes=2, steps=4,
+            state_dir=tmp_path / f"pvc-{pid}", port=port,
+            serving="paged",
+        ))
+        env = dict(
+            os.environ,
+            FAKE_POD_NAME=f"kvedge-tpu-runtime-{pid}",
+            KVEDGE_SERVE_TOML=str(toml_path),
+            PYTHONPATH=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        )
+        env.pop("XLA_FLAGS", None)  # 1 CPU device per "pod"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _PAGED_SERVE_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=tmp_path,
+        ))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"serve worker failed:\n{out}\n{err}"
+        outs.append(out)
+    leader_out = outs[0]
+    assert "BACKEND multihost-paged" in leader_out
+    assert any("FOLLOWER503 GenerateUnavailable" in o for o in outs)
+
+    # Reference: the SAME shared checkpoint restored single-host here.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kvedge_tpu.models import generate, init_params, make_train_step
+    from kvedge_tpu.runtime.checkpoint import StateCheckpointer
+    from kvedge_tpu.runtime.workload import train_model_config
+
+    cfg = RuntimeConfig.parse((tmp_path / "serve-0.toml").read_text())
+    tcfg, _ = train_model_config(
+        RuntimeConfig.from_mapping({
+            "payload": {"seq": cfg.train_seq},
+        })
+    )
+    init_opt, _ = make_train_step(tcfg)
+
+    def fresh():
+        p = init_params(jax.random.PRNGKey(0), tcfg)
+        return {"params": p, "opt_state": init_opt(p)}
+
+    dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    abstract = jax.tree_util.tree_map(
+        lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                          sharding=dev),
+        jax.eval_shape(fresh),
+    )
+    with StateCheckpointer(
+        str(tmp_path / "ref-state"), checkpoint_dir=str(cfg.checkpoint_dir)
+    ) as ckpt:
+        step, tree = ckpt.restore_latest(abstract)
+    assert step == 4
+    params = tree["params"]
+
+    def want(prompt, n_new, sampling=None):
+        out = generate(
+            params, jnp.asarray([prompt], jnp.int32), tcfg, n_new=n_new,
+            sampling=sampling, sampled=sampling is not None,
+        )
+        return [int(t) for t in np.asarray(out)[0]]
+
+    # Greedy rows: both rode the same pool (and device windows).
+    tokens = json_mod.loads(re.search(r"TOKENS (.*)", leader_out).group(1))
+    assert tokens[0] == want([3, 1, 4], 8)
+    assert tokens[1] == want([2, 7, 1], 8)
+
+    # Sampled row: leader-local sampling, contiguous key schedule.
+    sampled = json_mod.loads(
+        re.search(r"SAMPLED (.*)", leader_out).group(1)
+    )
+    base_key = jax.random.PRNGKey(7)
+    seed_keys = jax.vmap(
+        lambda i: jax.random.fold_in(base_key, i)
+    )(jnp.arange(1))
+    assert sampled[0] == want(
+        [3, 1, 4], 3,
+        sampling=(seed_keys, jnp.float32(0.8), jnp.float32(0.9)),
+    )
+
+    # Streamed row: tokens crossed the op stream one window at a time.
+    streamed = json_mod.loads(
+        re.search(r"STREAMED (.*)", leader_out).group(1)
+    )
+    assert streamed[0] == want([5, 2, 6], 6)
 
 
 def test_two_process_train_survives_kill_and_matches_single(tmp_path):

@@ -245,23 +245,15 @@ def test_serve_payload_runs_on_all_mesh_families(tmp_path, axes, label):
         serve_fn.close()
 
 
-def test_multihost_serve_refuses_paged_and_unshared_checkpoints(
+def test_multihost_serve_refuses_unshared_checkpoints(
         tmp_path, monkeypatch):
-    """Multi-host serve is leader-serves (round 4, VERDICT r3 #7 — the
-    real 2-process proof lives in test_distributed.py); its two hard
-    requirements refuse loudly: contiguous backend only, and a shared
-    checkpoint_dir so every process restores the same params."""
+    """Multi-host serve is leader-serves (contiguous) or the cross-host
+    paged scheduler (round 4 — the real 2-process proofs live in
+    test_distributed.py); either way every process must restore the
+    SAME params, so a missing shared checkpoint_dir refuses loudly."""
     import jax
 
     monkeypatch.setattr(jax, "process_count", lambda: 2)
-    check, serve_fn = run_serve_payload(
-        _cfg(tmp_path, payload_serving="paged",
-             checkpoint_dir=str(tmp_path / "shared"))
-    )
-    assert serve_fn is None
-    assert not check.ok
-    assert "contiguous backend only" in check.error
-
     check, serve_fn = run_serve_payload(_cfg(tmp_path))
     assert serve_fn is None
     assert not check.ok

@@ -1,0 +1,220 @@
+"""Cross-host paged serving (runtime/sliceserve.py), single-process leg.
+
+The slice protocol's leader side runs the UNMODIFIED serving stack over
+a ``SlicePagedKVCache`` whose device seams broadcast before executing.
+On a single-process mesh the broadcast degenerates to a copy, so the
+whole leader path — global-array state, re-jitted kernels with pinned
+replicated out-shardings, host-mask derivation — is testable in-process
+against the plain cache/server, with exactness pinned the same way every
+other serving backend is. The 2-process proof (real op-stream replay by
+a follower) lives in tests/test_distributed.py.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kvedge_tpu.models import TransformerConfig, generate, init_params
+from kvedge_tpu.models.kvcache import PagedKVCache
+from kvedge_tpu.models.serving import PagedGenerationServer
+from kvedge_tpu.runtime.sliceserve import SlicePagedKVCache
+
+CFG = TransformerConfig(
+    vocab=128, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2, d_ff=64,
+    max_seq=64,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices()).reshape(2, 4)
+    return Mesh(devs, ("data", "model"))
+
+
+def _slice_server(params, mesh, **kw):
+    cache = SlicePagedKVCache(
+        CFG, slots=kw.pop("slots", 3), pages=kw.pop("pages", 24),
+        page_size=kw.pop("page_size", 16), mesh=mesh,
+    )
+    return PagedGenerationServer(params, CFG, cache=cache, **kw)
+
+
+def reference(params, prompt, n_new):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), CFG,
+                   n_new=n_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def test_slice_cache_matches_plain_cache_step_and_window(params, mesh):
+    """Direct cache equality: chunked prefill + per-token steps + a
+    device window produce identical tokens through both caches."""
+    plain = PagedKVCache(CFG, slots=2, pages=16, page_size=4)
+    sliced = SlicePagedKVCache(
+        CFG, slots=2, pages=16, page_size=4, mesh=mesh
+    )
+    prompt = [3, 1, 4, 1, 5, 9, 2]
+    seqs = []
+    for cache in (plain, sliced):
+        cache.admit(0, len(prompt))
+        logits = None
+        for off in range(0, len(prompt), 3):  # chunked prefill
+            piece = jnp.asarray(prompt[off:off + 3], jnp.int32)
+            logits = cache.prefill_chunk(params, 0, piece, off)
+        tok = int(np.argmax(np.asarray(logits)))
+        toks = [tok]
+        active = np.array([True, False])
+        for _ in range(3):
+            step_logits = cache.step(
+                params, jnp.asarray([tok, 0], jnp.int32), active=active
+            )
+            tok = int(np.argmax(np.asarray(step_logits)[0]))
+            toks.append(tok)
+        window = np.asarray(cache.step_window(
+            params, jnp.asarray([tok, 0], jnp.int32), 4, active=active
+        ))
+        toks.extend(int(t) for t in window[:, 0])
+        seqs.append(toks)
+    assert seqs[0] == seqs[1]
+
+
+def test_slice_server_greedy_matches_generate(params, mesh):
+    server = _slice_server(params, mesh)
+    try:
+        prompt = [5, 9, 2, 7, 1]
+        assert server.submit(prompt, n_new=6) == reference(
+            params, prompt, 6
+        )
+    finally:
+        server.close()
+
+
+def test_slice_server_concurrent_requests_each_match(params, mesh):
+    """Concurrent ragged requests through the slice cache ride one
+    batched step (windows included) and each still equals its own
+    contiguous decode — continuous batching is preserved across the
+    broadcast seams."""
+    server = _slice_server(params, mesh)
+    requests = [([5, 9, 2], 8), ([1, 1, 4, 3, 7, 7], 4), ([100, 50], 12)]
+    results: dict[int, list[int]] = {}
+    errors: list[Exception] = []
+
+    def worker(i, prompt, n_new):
+        try:
+            results[i] = server.submit(prompt, n_new)
+        except Exception as e:
+            errors.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=worker, args=(i, p, n))
+            for i, (p, n) in enumerate(requests)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        for i, (prompt, n_new) in enumerate(requests):
+            assert results[i] == reference(params, prompt, n_new), i
+    finally:
+        server.close()
+
+
+def test_slice_server_sampled_and_streamed_match_plain_server(
+        params, mesh):
+    """Sampling is leader-local (only chosen tokens enter the op
+    stream): a sampled and a streamed request through the slice server
+    must match the plain single-host paged server exactly."""
+    sampling = (jax.random.fold_in(jax.random.PRNGKey(7), 0),
+                jnp.float32(0.8), jnp.float32(0.9))
+    prompt = [9, 8, 7, 6]
+
+    plain = PagedGenerationServer(params, CFG, slots=2, pages=16)
+    try:
+        want_sampled = plain.submit(prompt, 5, sampling=sampling)
+        want_streamed = list(plain.submit_stream(prompt, 5))
+    finally:
+        plain.close()
+
+    server = _slice_server(params, mesh, slots=2, pages=16)
+    try:
+        assert server.submit(prompt, 5, sampling=sampling) == want_sampled
+        assert list(server.submit_stream(prompt, 5)) == want_streamed
+    finally:
+        server.close()
+
+
+def test_sharded_pool_matches_reference(params):
+    """When kv_heads divides the model axis size the K/V pools shard
+    over it (a model-sharded layer's K/V scatters stay local); tokens
+    must still equal the contiguous decode exactly."""
+    from jax.sharding import PartitionSpec as P
+
+    devs = np.array(jax.devices()).reshape(4, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    cache = SlicePagedKVCache(CFG, slots=2, pages=16, page_size=8,
+                              mesh=mesh)
+    assert cache.state.pool_k.sharding.spec == P(
+        None, None, None, "model", None
+    )
+    server = PagedGenerationServer(params, CFG, cache=cache)
+    try:
+        prompt = [5, 9, 2, 7, 1]
+        assert server.submit(prompt, n_new=6) == reference(
+            params, prompt, 6
+        )
+    finally:
+        server.close()
+
+
+def test_hard_close_mid_request_and_double_close_do_not_hang(
+        params, mesh):
+    """The follower-release (OP_STOP) rides the server's close under
+    the server lock: a hard close racing an in-flight request must not
+    let the request's teardown broadcast after STOP (its table sync
+    becomes a local no-op), and a second close() must not broadcast a
+    second STOP (idempotent flag). Either bug hangs the leader in a
+    collective — this test completing IS the assertion."""
+    server = _slice_server(params, mesh, slots=2, pages=16)
+    errors: list = []
+
+    def worker():
+        try:
+            server.submit([1, 2, 3], n_new=40)
+        except Exception as e:
+            errors.append(e)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    while server.stats()["in_flight"] == 0 and t.is_alive():
+        time.sleep(0.001)  # request admitted (or already failed)
+    server.close()           # hard close mid-decode
+    server.close()           # idempotent second close
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert server._cache._stopped
+
+
+def test_slice_server_prefix_sharing_stays_exact(params, mesh):
+    """The prefix registry (host-only leader state) composes with the
+    slice cache: a repeated prompt reuses pinned pages and still decodes
+    the same tokens."""
+    server = _slice_server(params, mesh, page_size=4)
+    try:
+        prompt = [11, 12, 13, 14, 15, 16, 17, 18, 19]
+        first = server.submit(prompt, n_new=4)
+        again = server.submit(prompt, n_new=4)
+        assert first == again == reference(params, prompt, 4)
+        assert server.stats()["prefix_hits"] >= 1
+    finally:
+        server.close()
