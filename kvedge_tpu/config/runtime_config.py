@@ -255,6 +255,13 @@ class RuntimeConfig:
     # (exact — K/V depend only on prompt tokens/positions). Pins are
     # evicted LRU under pool pressure.
     serving_prefix_cache: bool = True
+    # Prefix-cache persistence: on shutdown the registry's pinned K/V
+    # pages dump to ``<state_dir>/prefix-cache.npz`` and a rescheduled
+    # serve pod re-pins them at boot — warm prefixes ride the state
+    # volume like checkpoints do. Guarded by a fingerprint (checkpoint
+    # step + model geometry): a cache from different params is ignored,
+    # never half-trusted. Single-host paged backend only.
+    serving_prefix_persist: bool = True
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -373,6 +380,9 @@ class RuntimeConfig:
                 serving_prefix_cache=payload_doc.get(
                     "serving_prefix_cache", cls.serving_prefix_cache
                 ),
+                serving_prefix_persist=payload_doc.get(
+                    "serving_prefix_persist", cls.serving_prefix_persist
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -438,6 +448,10 @@ class RuntimeConfig:
         if not isinstance(self.serving_prefix_cache, bool):
             raise RuntimeConfigError(
                 "[payload] serving_prefix_cache must be a boolean"
+            )
+        if not isinstance(self.serving_prefix_persist, bool):
+            raise RuntimeConfigError(
+                "[payload] serving_prefix_persist must be a boolean"
             )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
@@ -512,6 +526,8 @@ class RuntimeConfig:
             f"serving_prefill_chunk = {self.serving_prefill_chunk}\n"
             "serving_prefix_cache = "
             f"{'true' if self.serving_prefix_cache else 'false'}\n"
+            "serving_prefix_persist = "
+            f"{'true' if self.serving_prefix_persist else 'false'}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

@@ -254,6 +254,45 @@ class PagedKVCache:
 
     # ---- data plane (device) --------------------------------------------
 
+    def read_pages(self, ids: list[int]):
+        """Host copies of the K/V data in ``ids``: two arrays
+        ``[L, n, page, K, Dh]``. One gather + transfer per pool — the
+        prefix-persistence dump path (models/serving.py)."""
+        import numpy as np
+
+        idx = jnp.asarray(ids, jnp.int32)
+        return (np.asarray(self.state.pool_k[:, idx]),
+                np.asarray(self.state.pool_v[:, idx]))
+
+    def write_pages(self, ids: list[int], k_vals, v_vals) -> None:
+        """Scatter K/V data ([L, n, page, K, Dh]) into pages ``ids`` —
+        ONE batched device update per pool (a per-page loop would copy
+        the whole pool once per page). The persistence load path; the
+        caller owns allocation/refcounts for these pages."""
+        idx = jnp.asarray(ids, jnp.int32)
+        dtype = self.state.pool_k.dtype
+        self.state = dataclasses.replace(
+            self.state,
+            pool_k=self.state.pool_k.at[:, idx].set(
+                jnp.asarray(k_vals, dtype)
+            ),
+            pool_v=self.state.pool_v.at[:, idx].set(
+                jnp.asarray(v_vals, dtype)
+            ),
+        )
+
+    def allocate_pinned_page(self) -> int:
+        """Take one page off the free list with refcount 1, owned by the
+        caller (the persistence loader's registry pins — there is no
+        slot whose reservation covers them). Raises when the pool is
+        exhausted; the loader checks ``free_pages`` first and never
+        invokes pressure relief (loading cache must not evict cache)."""
+        if not self._free:
+            raise PagedCacheError("pool exhausted: no page to pin")
+        page = self._free.pop()
+        self._refs[page] += 1
+        return page
+
     def prefill(self, params: dict, slot: int, prompt) -> jax.Array:
         """Feed a 1D prompt into ``slot`` (after :meth:`admit`).
 

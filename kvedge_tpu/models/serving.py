@@ -396,6 +396,21 @@ class PagedGenerationServer:
                 best = (node, tuple(entry["pages"]), k * page)
         return best
 
+    def _trie_child(self, node: int, block: tuple) -> int:
+        """The trie child for ``block`` under ``node``, created if
+        absent (lock held) — the ONE node-allocation walk step, shared
+        by live registration and the persistence loader."""
+        child = self._prefix_children.get((node, block))
+        if child is None:
+            child = self._prefix_next_id
+            self._prefix_next_id += 1
+            self._prefix_children[(node, block)] = child
+            self._prefix_nodes[child] = {
+                "parent": (node, block), "children": 0, "entry": None,
+            }
+            self._prefix_nodes[node]["children"] += 1
+        return child
+
     def _register_prefixes(self, prompt: list[int],
                            pages: list[int]) -> None:
         """Pin every page-aligned prefix of a fully-prefilled prompt.
@@ -409,16 +424,7 @@ class PagedGenerationServer:
         node = 0
         for k in range(1, len(prompt) // page + 1):
             block = tuple(prompt[(k - 1) * page:k * page])
-            child = self._prefix_children.get((node, block))
-            if child is None:
-                child = self._prefix_next_id
-                self._prefix_next_id += 1
-                self._prefix_children[(node, block)] = child
-                self._prefix_nodes[child] = {
-                    "parent": (node, block), "children": 0, "entry": None,
-                }
-                self._prefix_nodes[node]["children"] += 1
-            node = child
+            node = self._trie_child(node, block)
             if self._prefix_nodes[node]["entry"] is None:
                 held = list(pages[:k])
                 self._cache.retain_pages(held)
@@ -470,6 +476,151 @@ class PagedGenerationServer:
             )
             self._evict_prefix_node(victim)
         return self._cache.free_pages() >= needed
+
+    # ---- prefix persistence ---------------------------------------------
+    #
+    # The registry's pinned pages are device state, so a pod reschedule
+    # loses them — unless they ride the state volume like every other
+    # thing worth keeping (the reference's whole resilience story is
+    # PVC-backed state, README.md:88). dump writes tokens + K/V of every
+    # registered entry; load re-pins them into a fresh server. K/V are
+    # valid ONLY for the params that produced them: the caller passes a
+    # fingerprint (checkpoint step + model geometry) and a mismatched
+    # file is ignored, never half-trusted.
+
+    def _node_tokens(self, node: int) -> list[int]:
+        """A trie node's full token path (lock held)."""
+        blocks = []
+        cur = node
+        while cur != 0:
+            parent_id, block = self._prefix_nodes[cur]["parent"]
+            blocks.append(block)
+            cur = parent_id
+        return [t for block in reversed(blocks) for t in block]
+
+    def dump_prefix_cache(self, path: str, fingerprint: str) -> int:
+        """Persist the prefix registry to ``path`` (.npz). Returns the
+        number of entries written (0 = nothing registered, no file
+        touched). Callable any time before close — the lock serializes
+        against the decode loop."""
+        import json
+
+        with self._lock:
+            entries = [
+                {"tokens": self._node_tokens(node),
+                 "pages": list(entry["pages"])}
+                for node, entry in self._prefix_entry_nodes.items()
+            ]
+            if not entries:
+                return 0
+            page_ids = sorted({p for e in entries for p in e["pages"]})
+            pool_k, pool_v = self._cache.read_pages(page_ids)
+        # npz has no bfloat16; float32 holds bf16 (and fp16) exactly,
+        # and the load path casts back to the pool dtype.
+        pool_k = np.asarray(pool_k, np.float32)
+        pool_v = np.asarray(pool_v, np.float32)
+        doc = {
+            "fingerprint": fingerprint,
+            "page_size": self._cache.page_size,
+            "entries": entries,
+            "page_ids": page_ids,
+        }
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, doc=np.frombuffer(
+                json.dumps(doc).encode(), np.uint8
+            ), pool_k=pool_k, pool_v=pool_v)
+        import os
+
+        os.replace(tmp, path)  # atomic: never a torn cache file
+        return len(entries)
+
+    def load_prefix_cache(self, path: str, fingerprint: str) -> int:
+        """Re-pin a dumped registry into this (fresh) server. Returns
+        entries loaded; 0 with a reason logged when the file is absent,
+        stale (fingerprint/page-size mismatch), or the pool too full.
+        Entries load ancestors-first so nested prefixes share pages
+        exactly as they did live; loading stops (never evicts) when the
+        free list runs short — a cache must not displace capacity."""
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return 0
+        try:
+            with np.load(path) as data:
+                # Fingerprint first: a stale file (training advanced the
+                # checkpoint) must not pay the K/V decompression — npz
+                # members load lazily on access.
+                doc = json.loads(bytes(data["doc"]).decode())
+                if (doc.get("fingerprint") != fingerprint
+                        or doc.get("page_size")
+                        != self._cache.page_size):
+                    print(f"[kvedge-serve] ignoring stale prefix cache "
+                          f"{path} (fingerprint/page-size changed)",
+                          flush=True)
+                    return 0
+                pool_k, pool_v = data["pool_k"], data["pool_v"]
+        except Exception as e:
+            print(f"[kvedge-serve] ignoring unreadable prefix cache "
+                  f"{path}: {e!r}", flush=True)
+            return 0
+        old_pos = {p: i for i, p in enumerate(doc["page_ids"])}
+        loaded = 0
+        with self._lock:
+            if (not self._prefix_enabled or self._closed
+                    or self._prefix_entry_nodes):
+                # Boot-time only: loading into a registry that already
+                # has live entries would need dedup-against-live (and
+                # two loads would double-pin); nothing needs it.
+                return 0
+            remap: dict[int, int] = {}
+            writes: list[tuple[int, int]] = []  # (new_id, dump position)
+            for e in sorted(doc["entries"],
+                            key=lambda e: len(e["tokens"])):
+                fresh = set(p for p in e["pages"] if p not in remap)
+                if len(fresh) > self._cache.free_pages():
+                    # Skip, don't stop: sibling subtrees are not ordered
+                    # by fresh-page need (a later descendant sharing an
+                    # already-loaded ancestor may need fewer pages than
+                    # an unrelated same-length entry that didn't fit).
+                    continue
+                for p in fresh:
+                    new = self._cache.allocate_pinned_page()
+                    remap[p] = new
+                    writes.append((new, old_pos[p]))
+                # Refcount shape must equal live registration's: one ref
+                # per entry per page it holds. A freshly allocated page's
+                # ref 1 IS this entry's hold; pages shared from earlier
+                # entries take one more.
+                self._cache.retain_pages(
+                    [remap[p] for p in e["pages"] if p not in fresh]
+                )
+                self._insert_prefix_entry(
+                    e["tokens"], [remap[p] for p in e["pages"]]
+                )
+                loaded += 1
+            if writes:
+                ids = [w for w, _ in writes]
+                pos = [i for _, i in writes]
+                self._cache.write_pages(
+                    ids, pool_k[:, pos], pool_v[:, pos]
+                )
+        return loaded
+
+    def _insert_prefix_entry(self, tokens: list[int],
+                             pages: list[int]) -> None:
+        """Create the trie path for ``tokens`` and attach an entry
+        holding ``pages`` (lock held; refs already owned)."""
+        page = self._cache.page_size
+        node = 0
+        for k in range(1, len(tokens) // page + 1):
+            node = self._trie_child(
+                node, tuple(tokens[(k - 1) * page:k * page])
+            )
+        entry = {"pages": pages, "last_used": time.monotonic()}
+        self._prefix_nodes[node]["entry"] = entry
+        self._prefix_entry_nodes[node] = entry
 
     def close(self, drain: bool = False) -> None:
         """Shut down. Hard close (default) poisons in-flight requests

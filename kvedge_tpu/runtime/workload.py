@@ -1168,6 +1168,7 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
     max_rows = 4 * cfg.serving_slots
     row_pool = None
     paged_server = None
+    prefix_path, fp = "", ""
     try:
         if cache is not None or cfg.payload_serving == "paged":
             from kvedge_tpu.models.serving import PagedGenerationServer
@@ -1183,6 +1184,26 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 prefix_cache=cfg.serving_prefix_cache,
                 cache=cache,
             )
+            # Prefix persistence (single-host only: the slice cache's
+            # pool is a global array the leader cannot dump alone):
+            # warm prefixes from the previous pod generation re-pin at
+            # boot, fingerprint-guarded so K/V from other params are
+            # ignored; the dump happens at close, below.
+            if (cache is None and cfg.serving_prefix_persist
+                    and cfg.serving_prefix_cache and cfg.state_dir):
+                import os as os_mod
+
+                prefix_path = os_mod.path.join(
+                    cfg.state_dir, "prefix-cache.npz"
+                )
+                fp = (f"step={restored_step} {tcfg.vocab}v "
+                      f"{tcfg.d_model}d {tcfg.n_heads}h "
+                      f"{tcfg.kv_heads}kv {tcfg.n_layers}L "
+                      f"{tcfg.d_ff}ff {tcfg.max_seq}T {tcfg.dtype}")
+                n = paged_server.load_prefix_cache(prefix_path, fp)
+                if n:
+                    print(f"[kvedge-serve] re-pinned {n} prefix-cache "
+                          f"entries from {prefix_path}", flush=True)
             # One shared pool for row priming AND stream pumping, sized
             # 2x slots (only `slots` rows decode concurrently; one
             # primer + one pump each is the useful parallelism). Excess
@@ -1492,6 +1513,16 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
         def _close(drain: bool = False) -> None:
             if paged_server is not None:
                 paged_server.close(drain=drain)
+                if prefix_path:
+                    # AFTER close: a drain's late completions register
+                    # prefixes too, and the registry + device pool
+                    # survive close (nothing clears them). Best-effort:
+                    # a failed dump must not block the shutdown path.
+                    try:
+                        paged_server.dump_prefix_cache(prefix_path, fp)
+                    except Exception as e:
+                        print(f"[kvedge-serve] prefix-cache dump "
+                              f"failed: {e!r}", flush=True)
             if row_pool is not None:
                 # Drain must let QUEUED pumps run: a streamed request
                 # wider than the pool still has rows waiting to pump,

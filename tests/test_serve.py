@@ -721,3 +721,50 @@ def test_stream_multiple_rows_merge_with_attribution(tmp_path):
         assert final["tokens"] == want["tokens"]
     finally:
         serve_fn.close()
+
+
+def test_prefix_cache_persists_across_serve_restarts(tmp_path):
+    """The pod-reschedule story for warm prefixes: a serve runtime's
+    registry dumps to the state volume at shutdown and the next serve
+    runtime re-pins it at boot — the first request after the 'restart'
+    is a prefix hit with tokens identical to the cold decode."""
+    cfg = _cfg(tmp_path, payload_serving="paged", serving_page_size=4)
+    prompt = [7, 3, 9, 1, 5, 5, 2, 8]  # two full pages at page_size 4
+
+    check, serve_fn = run_serve_payload(cfg)
+    assert check.ok, check.error
+    try:
+        cold = serve_fn({"tokens": [prompt], "n_new": 4})["tokens"]
+    finally:
+        serve_fn.close()  # dumps <state_dir>/prefix-cache.npz
+    import os
+
+    assert os.path.exists(os.path.join(cfg.state_dir,
+                                       "prefix-cache.npz"))
+
+    check, revived_fn = run_serve_payload(cfg)
+    assert check.ok, check.error
+    try:
+        # 3 = the prompt's 1- and 2-page prefixes + the boot probe's
+        # one full page (the probe registered live in run 1, so its
+        # entry persisted too; in run 2 it re-registers onto the loaded
+        # node — a no-op).
+        stats = revived_fn.stats()
+        assert stats["prefix_entries"] == 3, stats
+        warm = revived_fn({"tokens": [prompt], "n_new": 4})["tokens"]
+        assert warm == cold
+        assert revived_fn.stats()["prefix_hits"] == 1
+    finally:
+        revived_fn.close()
+
+    # Persistence off: the file is not read — only the live probe
+    # entry exists.
+    check, off_fn = run_serve_payload(
+        _cfg(tmp_path, payload_serving="paged", serving_page_size=4,
+             serving_prefix_persist=False)
+    )
+    assert check.ok, check.error
+    try:
+        assert off_fn.stats()["prefix_entries"] == 1
+    finally:
+        off_fn.close()

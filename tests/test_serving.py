@@ -727,3 +727,104 @@ def test_submit_stream_concurrent_with_blocking_request(params):
         assert blocking[0] == reference(params, [3, 1, 4], 10)
     finally:
         server.close()
+
+
+# ---- prefix-cache persistence (round 4) ----------------------------------
+
+
+def test_prefix_cache_dump_load_round_trip(params, tmp_path):
+    """A dumped registry re-pins into a fresh server: the first request
+    after the reload shares the persisted prefix immediately (zero
+    recomputation for the cached pages) and decodes exactly the tokens
+    a cold server would."""
+    path = str(tmp_path / "prefix.npz")
+    base = [7, 3, 9, 1, 5, 5, 2, 8]  # two full 4-token pages
+    server = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                   page_size=4)
+    try:
+        warm = server.submit(base + [4, 6], n_new=4)
+        assert server.dump_prefix_cache(path, "fp-1") == 2
+    finally:
+        server.close()
+
+    revived = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                    page_size=4, prefill_chunk=4)
+    calls: list = []
+    real_chunk = revived._cache.prefill_chunk
+
+    def counting_chunk(params_, slot, tokens, offset):
+        calls.append((int(offset), int(tokens.shape[0])))
+        return real_chunk(params_, slot, tokens, offset)
+
+    revived._cache.prefill_chunk = counting_chunk
+    try:
+        assert revived.load_prefix_cache(path, "fp-1") == 2
+        stats = revived.stats()
+        assert stats["prefix_entries"] == 2
+        got = revived.submit(base + [4, 6], n_new=4)
+        assert got == warm == reference(params, base + [4, 6], 4)
+        # Only the 2-token suffix prefilled: the 8 prefix tokens came
+        # off the persisted pages.
+        assert calls == [(8, 2)], calls
+        assert revived.stats()["prefix_hits"] == 1
+        assert revived.stats()["prefix_tokens_saved"] == 8
+    finally:
+        revived.close()
+
+
+def test_prefix_cache_load_rejects_stale_and_respects_capacity(
+        params, tmp_path):
+    """A fingerprint mismatch ignores the file wholesale (K/V from
+    other params must never serve); a pool too small for the dump loads
+    ancestors-first and stops instead of evicting or failing."""
+    path = str(tmp_path / "prefix.npz")
+    server = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                   page_size=4)
+    try:
+        server.submit([1, 1, 1, 1, 9], n_new=4)           # 1-page entry
+        server.submit([2, 2, 2, 2, 3, 3, 3, 3, 9], n_new=4)  # 1pg + 2pg
+        assert server.dump_prefix_cache(path, "fp-1") == 3
+    finally:
+        server.close()
+
+    stale = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                  page_size=4)
+    try:
+        assert stale.load_prefix_cache(path, "fp-OTHER") == 0
+        assert stale.stats()["prefix_entries"] == 0
+    finally:
+        stale.close()
+
+    # 2 pages total: the two 1-page entries load (ancestors first); the
+    # 2-page entry's fresh page finds the free list empty and the load
+    # STOPS — it never evicts what it just pinned and never fails.
+    tiny = PagedGenerationServer(params, CFG, slots=1, pages=2,
+                                 page_size=4)
+    try:
+        assert tiny.load_prefix_cache(path, "fp-1") == 2
+        stats = tiny.stats()
+        assert stats["prefix_entries"] == 2
+        assert stats["free_pages"] == 0
+        # The surviving entries still serve: this request shares the
+        # [2,2,2,2] page, and its admission evicts the OTHER pin (LRU,
+        # never the matched entry) to cover its private budget — the
+        # live eviction discipline applies to revived pins unchanged.
+        got = tiny.submit([2, 2, 2, 2, 5], n_new=3)
+        assert got == reference(params, [2, 2, 2, 2, 5], 3)
+        assert tiny.stats()["prefix_hits"] == 1
+    finally:
+        tiny.close()
+
+
+def test_prefix_cache_load_is_boot_time_only(params, tmp_path):
+    path = str(tmp_path / "prefix.npz")
+    server = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                   page_size=4)
+    try:
+        server.submit([7, 3, 9, 1, 5], n_new=4)
+        assert server.dump_prefix_cache(path, "fp-1") == 1
+        # Live registry present: a (second) load must refuse — it would
+        # double-pin shared pages.
+        assert server.load_prefix_cache(path, "fp-1") == 0
+    finally:
+        server.close()
