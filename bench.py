@@ -326,6 +326,81 @@ def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
     return slots * n_new / best, n_new / best, n_new / best_host
 
 
+def measure_paged_spec(cfg, slots: int, prompt_len: int, n_new: int,
+                       page_size: int, draft_len: int):
+    """Batched speculative decoding through the paged cache (round 4's
+    serving_speculative mode): (tokens/s, emitted_per_pass).
+
+    All ``slots`` sequences admit REPETITIVE prompts (prompt-lookup
+    drafting's favorable case, matching measure_speculative's input so
+    the two capabilities are comparable), then the serving loop's spec
+    schedule runs: host drafts per slot, ONE (1+draft_len)-query verify
+    pass for the batch per dispatch, up to draft_len+1 tokens per slot
+    per pass. One dispatch + one host read per pass — the same
+    RTT-per-pass profile as the windowed path at window≈emitted."""
+    import types
+
+    from kvedge_tpu.models.kvcache import PagedKVCache
+    from kvedge_tpu.models.serving import PagedGenerationServer
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mpps = -(-(prompt_len + n_new + draft_len) // page_size)
+    pattern = jax.random.randint(
+        jax.random.PRNGKey(3), (1, 16), 0, cfg.vocab, dtype=jnp.int32
+    )
+    prompt = jnp.tile(pattern, (1, prompt_len // 16))[0]
+
+    def run(cache) -> tuple[float, float]:
+        reqs = []
+        tokens0 = []
+        for s in range(slots):
+            cache.admit(s, prompt_len)
+            logits = cache.prefill(params, s, prompt)
+            reqs.append(types.SimpleNamespace(
+                prompt=[int(t) for t in np.asarray(prompt)],
+                generated=[], next_token=int(jnp.argmax(logits)),
+            ))
+            tokens0.append(reqs[-1].next_token)
+        float(jnp.asarray(tokens0).sum())  # sync prefill out of timing
+        passes = 0
+        start = time.perf_counter()
+        active = np.ones((slots,), bool)
+        spec_mask = np.ones((slots,), bool)
+        while any(len(r.generated) < n_new for r in reqs):
+            tokens = np.zeros((slots, draft_len + 1), np.int32)
+            for s, r in enumerate(reqs):
+                tokens[s, 0] = r.next_token
+                tokens[s, 1:] = PagedGenerationServer._draft(
+                    r, draft_len
+                )
+            emitted, accepted, _ = cache.step_spec(
+                params, tokens, active=active, spec_mask=spec_mask
+            )
+            emitted = np.asarray(emitted)
+            passes += 1
+            for s, r in enumerate(reqs):
+                a = int(accepted[s])
+                seq = [r.next_token] + [int(t) for t in emitted[s, :a]]
+                room = n_new - len(r.generated)
+                r.generated.extend(seq[:room])
+                r.next_token = (seq[room] if room < len(seq)
+                                else int(emitted[s, a]))
+        elapsed = time.perf_counter() - start
+        for s in range(slots):
+            cache.release(s)
+        return elapsed, slots * n_new / passes / slots
+
+    cache = PagedKVCache(
+        cfg, slots=slots, pages=slots * mpps, page_size=page_size,
+        max_pages_per_seq=mpps,
+    )
+    for _ in range(3):
+        run(cache)
+    results = [run(cache) for _ in range(3)]
+    best = min(r[0] for r in results)
+    return slots * n_new / best, results[0][1]
+
+
 SPEC_DRAFT_LEN = 4
 
 # The demonstrated speculative-decode crossover shape: ONE definition,
@@ -468,6 +543,10 @@ def main() -> int:
     spec_tps, plain_b1_tps, spec_accept = measure_speculative(
         gqa, DECODE_PROMPT, DECODE_NEW
     )
+    paged_spec_tps, paged_spec_epp = measure_paged_spec(
+        gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE,
+        SPEC_DRAFT_LEN,
+    )
     # Where speculation PAYS (VERDICT r3 #3): at the flagship scale the
     # per-verify fixed cost eats the acceptance (~1.05x above); the
     # crossover study (tools/bench_spec_crossover.py,
@@ -501,6 +580,19 @@ def main() -> int:
                     paged_host_sps, 1
                 ),
                 "paged_decode_slots": PAGED_SLOTS,
+                # Batched speculative serving (serving_speculative=4)
+                # on the same favorable repetitive input as the
+                # single-row spec metrics: one verify pass advances
+                # every slot up to 5 tokens — an RTT amortization of
+                # emitted_per_pass, vs page_size (16) for the windowed
+                # path. Under this relay's RTT the number is therefore
+                # transport-bound and BELOW the windowed rate; the mode
+                # pays on deployments where decode is model-cost-bound
+                # (sub-ms RTT or big models — the crossover study's
+                # regime), not on a degraded relay. relay_rtt_ms is the
+                # covariate to read it against.
+                "paged_spec_tokens_per_sec": round(paged_spec_tps, 1),
+                "paged_spec_emitted_per_pass": round(paged_spec_epp, 2),
                 # Session covariate: per-step-sync loops are RTT-bound;
                 # the windowed path amortizes RTT ~page_size x. Observed
                 # RTT ranges ~1.5-108 ms across sessions.

@@ -262,6 +262,15 @@ class RuntimeConfig:
     # step + model geometry): a cache from different params is ignored,
     # never half-trusted. Single-host paged backend only.
     serving_prefix_persist: bool = True
+    # Server-wide speculative decoding for the paged backend: draft
+    # length K (0 = off). Greedy traffic advances by batched verify
+    # passes — K prompt-lookup drafts per slot, up to K+1 tokens per
+    # slot per model forward, token-for-token identical to plain
+    # greedy decode (drafts accept only where they equal the model's
+    # own argmax). Pays where decode is weight-bandwidth-bound: see
+    # SPEC_CROSSOVER_r04.json for the model-size crossover. Each
+    # request's page budget grows by K slack positions.
+    serving_speculative: int = 0
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -383,6 +392,10 @@ class RuntimeConfig:
                 serving_prefix_persist=payload_doc.get(
                     "serving_prefix_persist", cls.serving_prefix_persist
                 ),
+                serving_speculative=int(
+                    payload_doc.get("serving_speculative",
+                                    cls.serving_speculative)
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -452,6 +465,11 @@ class RuntimeConfig:
         if not isinstance(self.serving_prefix_persist, bool):
             raise RuntimeConfigError(
                 "[payload] serving_prefix_persist must be a boolean"
+            )
+        if not 0 <= self.serving_speculative <= 16:
+            raise RuntimeConfigError(
+                "[payload] serving_speculative (draft length) must be "
+                "in [0, 16] (0 = off)"
             )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
@@ -528,6 +546,7 @@ class RuntimeConfig:
             f"{'true' if self.serving_prefix_cache else 'false'}\n"
             "serving_prefix_persist = "
             f"{'true' if self.serving_prefix_persist else 'false'}\n"
+            f"serving_speculative = {self.serving_speculative}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

@@ -425,6 +425,45 @@ class PagedKVCache:
         )
         return toks
 
+    def step_spec(self, params, tokens, active, spec_mask):
+        """One speculative verify pass (see :func:`_spec_verify_core`).
+
+        ``tokens`` [slots, 1+K] int32; ``spec_mask`` [slots] bool marks
+        rows whose drafts may accept (greedy rows — sampled rows ride
+        with acceptance 0). Pages for the worst case (all K drafts
+        accepted) are grown up front — legal because the serving layer
+        reserves each speculative request's slack budget at admission.
+        Returns ``(emitted [slots, K+1], accepted [slots] np.int64,
+        logits0 [slots, V])``.
+        """
+        import numpy as _np
+
+        slots = self._step_slots(active)
+        k_len = tokens.shape[1] - 1
+        grew = False
+        for slot in slots:
+            grew |= self.grow_to(slot, k_len + 1)
+        if grew:
+            self._sync()
+        emitted, accepted, logits0 = self._device_spec(
+            params, tokens, active, spec_mask
+        )
+        accepted_np = _np.asarray(accepted)
+        for slot in slots:
+            self._host_lengths[slot] += 1 + int(accepted_np[slot])
+        return emitted, accepted_np, logits0
+
+    def _device_spec(self, params, tokens, active, spec_mask):
+        """Device seam: one batched verify pass over current state."""
+        import numpy as _np
+
+        emitted, accepted, logits0, self.state = _paged_spec_verify(
+            params, self.state, jnp.asarray(tokens, jnp.int32), self.cfg,
+            self._active_array(self.state, active),
+            jnp.asarray(_np.asarray(spec_mask, bool)),
+        )
+        return emitted, accepted, logits0
+
 
 # ---- jitted kernels ------------------------------------------------------
 
@@ -477,8 +516,10 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     normed = _rmsnorm(x, ln_attn)
     q, k, v = split_qkv(cfg, normed @ w_qkv.astype(dtype))
     # rotary wants [T]-shaped positions; rows share a position vector only
-    # in prefill (B=1). For decode q_len == 1: apply per-row via vmap.
-    if q_len == 1:
+    # in prefill (B=1). Decode/verify rows each carry their own
+    # positions: apply per-row via vmap (q_len 1 for plain decode,
+    # 1 + draft_len for a speculative verify pass).
+    if slot is None:
         rot = jax.vmap(lambda t, p: _rotary(t[None], p)[0])
         q = rot(q, q_positions)
         k = rot(k, q_positions)
@@ -489,8 +530,19 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     if slot is None:
         tables, lengths = state.tables, state.lengths
         active = lengths > 0
-        new_pool_k = _scatter_token(pool_k_l, tables, lengths, k[:, 0], active)
-        new_pool_v = _scatter_token(pool_v_l, tables, lengths, v[:, 0], active)
+        # One scatter per query offset (static q_len): row b's token i
+        # lands at position lengths[b] + i — multi-offset writes are how
+        # a verify pass persists the drafts' K/V in the same program
+        # that scores them (intra-pass causality is free: writes land
+        # before the gather, and the mask is on absolute positions).
+        new_pool_k, new_pool_v = pool_k_l, pool_v_l
+        for i in range(q_len):
+            new_pool_k = _scatter_token(
+                new_pool_k, tables, lengths + i, k[:, i], active
+            )
+            new_pool_v = _scatter_token(
+                new_pool_v, tables, lengths + i, v[:, i], active
+            )
     else:
         # Prefill: scatter q_len rows of one slot at their ABSOLUTE
         # positions (chunked prefill passes an offset, so a chunk's
@@ -531,7 +583,8 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     return x, new_pool_k, new_pool_v
 
 
-def _run_paged(cfg, params, state, x, q_positions, slot=None):
+def _run_paged(cfg, params, state, x, q_positions, slot=None,
+               all_positions: bool = False):
     def body(carry, xs):
         layer_params, pool_k_l, pool_v_l = xs
         out, pool_k_l, pool_v_l = _paged_attend_layer(
@@ -545,7 +598,9 @@ def _run_paged(cfg, params, state, x, q_positions, slot=None):
                   state.pool_v)
     )
     x = _rmsnorm(x, params["ln_final"])
-    logits = tied_readout(x[:, -1], params["embedding"])
+    logits = tied_readout(
+        x if all_positions else x[:, -1], params["embedding"]
+    )
     return logits, new_k, new_v
 
 
@@ -595,6 +650,71 @@ def _decode_step_core(params: dict, state: PagedState, tokens,
 _paged_decode_step = functools.partial(
     jax.jit, static_argnames=("cfg",), donate_argnums=(1,)
 )(_decode_step_core)
+
+
+def _spec_verify_core(params: dict, state: PagedState, tokens,
+                      cfg: TransformerConfig, active, spec_mask):
+    """One batched speculative verify pass over the paged cache.
+
+    ``tokens`` is [B, 1+K]: each active row's pending token followed by
+    K drafted tokens. One forward with 1+K query positions per row
+    scores every draft (y[b, i] = the model's greedy token after row
+    b's prefix extended by tokens[b, :i+1]) and writes all 1+K tokens'
+    K/V; acceptance is the leading-agreement count, exactly the
+    contiguous speculative decoder's rule (models/speculative.py), so
+    emitted tokens are token-for-token the plain greedy decode.
+
+    ``spec_mask`` [B] bool: rows whose drafts may accept. A sampled row
+    rides the same pass with acceptance forced to 0 — it advances by
+    exactly its pending token (position ``length``), its draft slots'
+    junk K/V landing at length+1..length+K, provably overwritten before
+    any read: the row's next pass writes length+1..length+1+K, and the
+    causal mask hides junk beyond the query positions meanwhile.
+
+    Returns ``(emitted [B, K+1], accepted [B], logits0 [B, V], state)``:
+    row b's first ``accepted[b]`` emitted entries are its accepted
+    drafts, entry ``accepted[b]`` is the bonus token (the model's own
+    argmax after them); ``logits0`` is the pending-token position's
+    logits for host-side sampling. Lengths advance by
+    ``1 + accepted`` per active row — the pending token's K/V plus the
+    accepted drafts'; the bonus token's K/V is the next pass's pending
+    write, exactly like plain decode.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    k_len = tokens.shape[1] - 1
+    x = params["embedding"][tokens].astype(dtype)  # [B, 1+K, D]
+    q_positions = (state.lengths[:, None]
+                   + jnp.arange(1 + k_len)[None])  # [B, 1+K]
+    masked = dataclasses.replace(
+        state, lengths=jnp.where(active, state.lengths, 0)
+    )
+    logits, new_k, new_v = _run_paged(
+        cfg, params, masked, x, q_positions, all_positions=True
+    )  # [B, 1+K, V]
+    y = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, 1+K]
+    draft = tokens[:, 1:]
+    agree = jnp.cumprod(
+        (draft == y[:, :k_len]).astype(jnp.int32), axis=1
+    )
+    accepted = jnp.sum(agree, axis=1) * spec_mask.astype(jnp.int32)
+    idx = jnp.arange(k_len + 1)[None]
+    emitted = jnp.where(
+        idx < accepted[:, None],
+        jnp.concatenate([draft, y[:, -1:]], axis=1),
+        jnp.take_along_axis(y, accepted[:, None], axis=1),
+    ).astype(jnp.int32)
+    state = dataclasses.replace(
+        state,
+        pool_k=new_k,
+        pool_v=new_v,
+        lengths=state.lengths + active.astype(jnp.int32) * (1 + accepted),
+    )
+    return emitted, accepted, logits[:, 0], state
+
+
+_paged_spec_verify = functools.partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(1,)
+)(_spec_verify_core)
 
 
 def _paged_decode_window_impl(params: dict, state: PagedState, tokens,

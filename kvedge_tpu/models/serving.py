@@ -135,11 +135,23 @@ class PagedGenerationServer:
     def __init__(self, params: dict, cfg, *, slots: int = 4,
                  pages: int = 64, page_size: int = 16,
                  prefill_chunk: int = 0, prefix_cache: bool = True,
-                 cache=None):
+                 speculative: int = 0, cache=None):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
         self._cfg = cfg
+        # Speculative mode (draft length K, 0 = off): greedy slots
+        # advance by batched verify passes — K prompt-lookup drafts per
+        # slot, one (1+K)-query forward for the whole batch, up to K+1
+        # tokens emitted per slot per pass (exact: drafts accept only
+        # where they equal the model's own argmax). Sampled slots ride
+        # the same pass advancing one token. Every request's page
+        # budget carries K slack positions: a verify pass writes K/V at
+        # length..length+K even when nothing accepts.
+        self._spec = int(speculative)
+        self._spec_passes = 0
+        self._spec_emitted = 0      # tokens emitted by greedy slots
+        self._spec_slot_passes = 0  # greedy-slot participations
         # Chunked prefill granule (0 = whole-prompt): long prompts land
         # in fixed-size chunks with the lock RELEASED between chunks, so
         # in-flight requests keep decoding during an admission and XLA
@@ -154,8 +166,14 @@ class PagedGenerationServer:
         if cache is not None:
             slots, pages = cache.slots, cache.num_pages
             page_size = cache.page_size
+        # Spec mode widens the per-sequence table cap by the draft
+        # slack so a full-length (prompt + n_new == max_seq) request
+        # still admits; an injected cache was built with the same
+        # formula (workload._serving_pool_dims).
         self._cache = cache or PagedKVCache(
-            cfg, slots=slots, pages=pages, page_size=page_size
+            cfg, slots=slots, pages=pages, page_size=page_size,
+            max_pages_per_seq=-(-(cfg.max_seq + self._spec)
+                                // page_size),
         )
         # Prefix sharing: completed prompts register their page-aligned
         # prefixes here (key: token tuple -> pinned pages + LRU stamp);
@@ -267,7 +285,7 @@ class PagedGenerationServer:
                 f"prompt ({len(prompt)}) + n_new ({n_new}) exceeds the "
                 f"model's max_seq ({self._cfg.max_seq})"
             )
-        pages_needed = -(-total // self._cache.page_size)
+        pages_needed = self._pages_needed(total)
         if pages_needed > self._cache.max_pages_per_seq:
             raise ValueError(
                 f"request needs {pages_needed} pages > max_pages_per_seq "
@@ -540,8 +558,10 @@ class PagedGenerationServer:
         entries loaded; 0 with a reason logged when the file is absent,
         stale (fingerprint/page-size mismatch), or the pool too full.
         Entries load ancestors-first so nested prefixes share pages
-        exactly as they did live; loading stops (never evicts) when the
-        free list runs short — a cache must not displace capacity."""
+        exactly as they did live; an entry whose fresh pages exceed the
+        free list is SKIPPED (later entries that fit — e.g. descendants
+        sharing already-loaded pages — still load), and nothing is ever
+        evicted — a cache must not displace capacity."""
         import json
         import os
 
@@ -656,7 +676,7 @@ class PagedGenerationServer:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "in_flight": len(self._active),
                 "free_slots": len(self._free_slots),
                 "free_pages": self._cache.free_pages(),
@@ -665,6 +685,18 @@ class PagedGenerationServer:
                 "prefix_hits": self._prefix_hits,
                 "prefix_tokens_saved": self._prefix_tokens_saved,
             }
+            if self._spec:
+                # Realized acceleration PER GREEDY SLOT: mean tokens a
+                # greedy slot emits per verify pass it participates in
+                # (1.0 = speculation never paid; K+1 = every draft
+                # accepted) — normalized by slot-participations, not
+                # passes, so concurrency cannot inflate it.
+                out["spec_draft_len"] = self._spec
+                out["spec_passes"] = self._spec_passes
+                out["spec_emitted_per_pass"] = round(
+                    self._spec_emitted / self._spec_slot_passes, 3
+                ) if self._spec_slot_passes else 0.0
+            return out
 
     # ---- decode loop -----------------------------------------------------
 
@@ -676,8 +708,16 @@ class PagedGenerationServer:
         self._reserved -= pages_needed
         self._work.notify_all()
 
+    def _pages_needed(self, total: int) -> int:
+        """Worst-case pages for a ``total``-token request — plus the
+        speculative slack: a verify pass writes K/V for all K drafts at
+        length..length+K regardless of acceptance (sampled rows too —
+        their junk draft writes also need owned pages, or the scatter
+        would land in another sequence's page 0)."""
+        return -(-(total + self._spec) // self._cache.page_size)
+
     def _pages_for(self, req: _Request) -> int:
-        return -(-(len(req.prompt) + req.n_new) // self._cache.page_size)
+        return self._pages_needed(len(req.prompt) + req.n_new)
 
     @staticmethod
     def _emit(req: _Request, token: int) -> None:
@@ -685,6 +725,72 @@ class PagedGenerationServer:
         req.generated.append(token)
         if req.stream is not None:
             req.stream.put(token)
+
+    @staticmethod
+    def _draft(req: _Request, k: int) -> list[int]:
+        """K prompt-lookup drafts for a greedy request (host-side
+        mirror of models/speculative.py's n-gram proposer — drafting
+        needs no device work because the host owns every emitted
+        token). Any draft is legal; verification makes correctness
+        draft-independent."""
+        ctx = req.prompt + req.generated + [req.next_token]
+        g0, g1 = ctx[-2] if len(ctx) > 1 else ctx[-1], ctx[-1]
+        for p in range(len(ctx) - 3, -1, -1):
+            if ctx[p] == g0 and ctx[p + 1] == g1:
+                start = max(0, min(p + 2, len(ctx) - k))
+                cand = ctx[start:start + k]
+                return cand + [g1] * (k - len(cand))
+        return [g1] * k
+
+    def _spec_pass(self) -> None:
+        """One speculative verify pass for the active batch (lock
+        held). Greedy slots emit their pending token plus up to K
+        accepted drafts and a bonus; sampled slots advance exactly one
+        sampled token from the pass's pending-position logits —
+        identical schedule semantics to the per-step path, so the
+        key-schedule exactness holds unchanged."""
+        k = self._spec
+        n = self._cache.slots
+        tokens = np.zeros((n, k + 1), np.int32)
+        mask = np.zeros((n,), bool)
+        spec_mask = np.zeros((n,), bool)
+        for slot, req in self._active.items():
+            tokens[slot, 0] = req.next_token
+            mask[slot] = True
+            if req.sampling is None:
+                spec_mask[slot] = True
+                tokens[slot, 1:] = self._draft(req, k)
+        emitted, accepted, logits0 = self._cache.step_spec(
+            self._params, tokens, active=mask, spec_mask=spec_mask
+        )
+        emitted = np.asarray(emitted)
+        sampled_next = self._sample_slots(logits0, {
+            slot: req for slot, req in self._active.items()
+            if req.sampling is not None
+        })
+        self._spec_passes += 1
+        for slot in list(self._active):
+            req = self._active[slot]
+            if req.sampling is not None:
+                self._emit(req, req.next_token)
+                req.next_token = sampled_next[slot]
+                continue
+            a = int(accepted[slot])
+            room = req.n_new - len(req.generated)
+            seq = [req.next_token] + [int(t) for t in emitted[slot, :a]]
+            for t in seq[:room]:
+                self._emit(req, t)
+            self._spec_emitted += min(len(seq), room)
+            self._spec_slot_passes += 1
+            if len(req.generated) >= req.n_new:
+                del self._active[slot]
+                self._release_locked(slot, self._pages_for(req))
+                if req.stream is not None:
+                    req.stream.put(_STREAM_DONE)
+                req.done.set()
+            else:
+                req.next_token = (seq[room] if room < len(seq)
+                                  else int(emitted[slot, a]))
 
     def _window_steps(self) -> int:
         """Steps the next device-side decode window may run (lock held).
@@ -727,29 +833,42 @@ class PagedGenerationServer:
                 slot: int(greedy[slot])
                 for slot in self._active if slot not in samplers
             }
-        if samplers:
-            slots = sorted(samplers)
-            seed_keys = jnp.stack(
-                [samplers[s].sampling[0] for s in slots]
-            )
-            # Each request's token index is its own len(generated)+1 —
-            # one vmapped fold_in keeps the per-request key schedule.
-            steps = jnp.asarray(
-                [len(samplers[s].generated) + 1 for s in slots], jnp.int32
-            )
-            keys = jax.vmap(jax.random.fold_in)(seed_keys, steps)
-            temps = jnp.asarray(
-                [samplers[s].sampling[1] for s in slots], jnp.float32
-            )[:, None]
-            top_ps = jnp.asarray(
-                [samplers[s].sampling[2] for s in slots], jnp.float32
-            )[:, None]
-            picked = np.asarray(sample_token(
-                logits[jnp.asarray(slots)], keys, temps, top_ps
-            ))
-            for i, s in enumerate(slots):
-                out[s] = int(picked[i])
+        out.update(self._sample_slots(logits, samplers))
         return out
+
+    @staticmethod
+    def _sample_slots(logits, samplers: dict) -> dict[int, int]:
+        """Sampled slots' tokens from [slots, V] logits: ONE vmapped
+        fold_in (token index = each request's len(generated)+1, the
+        cross-backend key schedule) + ONE batched filter/categorical +
+        one host transfer. Shared by the per-step path and the
+        speculative pass, which samples from the pass's pending-position
+        logits without paying the greedy argmax."""
+        if not samplers:
+            return {}
+        import jax
+        import jax.numpy as jnp
+
+        from kvedge_tpu.models.decode import sample_token
+
+        slots = sorted(samplers)
+        seed_keys = jnp.stack(
+            [samplers[s].sampling[0] for s in slots]
+        )
+        steps = jnp.asarray(
+            [len(samplers[s].generated) + 1 for s in slots], jnp.int32
+        )
+        keys = jax.vmap(jax.random.fold_in)(seed_keys, steps)
+        temps = jnp.asarray(
+            [samplers[s].sampling[1] for s in slots], jnp.float32
+        )[:, None]
+        top_ps = jnp.asarray(
+            [samplers[s].sampling[2] for s in slots], jnp.float32
+        )[:, None]
+        picked = np.asarray(sample_token(
+            logits[jnp.asarray(slots)], keys, temps, top_ps
+        ))
+        return {s: int(picked[i]) for i, s in enumerate(slots)}
 
     def _loop(self) -> None:
         while True:
@@ -821,6 +940,15 @@ class PagedGenerationServer:
                             req.stream.put(_STREAM_DONE)
                         req.done.set()
                 if not self._active:
+                    return "ran"
+                if (self._spec > 0
+                        and any(req.sampling is None
+                                for req in self._active.values())):
+                    # Speculative mode: greedy slots advance by verify
+                    # passes (sampled slots ride along one token at a
+                    # time); an all-sampled batch falls through to the
+                    # cheaper single-query step below.
+                    self._spec_pass()
                     return "ran"
                 # Feed every active slot's pending token through ONE
                 # batched step; inactive slots carry zeros (masked).

@@ -49,10 +49,11 @@ from kvedge_tpu.models.kvcache import (
     _decode_step_core,
     _paged_decode_window_impl,
     _paged_prefill_impl,
+    _spec_verify_core,
 )
 
 # Op codes (header[0]). STOP ends the follower loop.
-OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW = range(5)
+OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW, OP_SPEC = range(6)
 _HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
 
 
@@ -92,7 +93,11 @@ def _slice_kernels(mesh, cfg):
         _paged_decode_window_impl, static_argnames=("cfg", "n_steps"),
         donate_argnums=(1,), out_shardings=(rep, state_sh),
     )
-    return rep, state_sh, prefill, step, window
+    spec = jax.jit(
+        _spec_verify_core, static_argnames=("cfg",),
+        donate_argnums=(1,), out_shardings=(rep, rep, rep, state_sh),
+    )
+    return rep, state_sh, prefill, step, window, spec
 
 
 class SlicePagedKVCache(PagedKVCache):
@@ -118,7 +123,7 @@ class SlicePagedKVCache(PagedKVCache):
 
         self.mesh = mesh
         (self._rep, self._state_sh, self._k_prefill, self._k_step,
-         self._k_window) = _slice_kernels(mesh, cfg)
+         self._k_window, self._k_spec) = _slice_kernels(mesh, cfg)
         self._is_leader = jax.process_index() == 0
         self._stopped = False
         super().__init__(
@@ -251,6 +256,27 @@ class SlicePagedKVCache(PagedKVCache):
         )
         return self._read(toks)
 
+    def _device_spec(self, params, tokens, active, spec_mask):
+        self._check_live()
+        tokens = np.asarray(tokens, np.int32)
+        self._send_header(OP_SPEC, tokens.shape[1] - 1)
+        tokens, mask, smask = self._bcast(
+            (tokens, self._active_np(active),
+             np.asarray(spec_mask, bool))
+        )
+        return self._exec_spec(params, np.asarray(tokens),
+                               np.asarray(mask), np.asarray(smask))
+
+    def _exec_spec(self, params, tokens: np.ndarray, mask: np.ndarray,
+                   spec_mask: np.ndarray):
+        emitted, accepted, logits0, self.state = self._k_spec(
+            params, self.state, self._global(tokens.astype(np.int32)),
+            self.cfg, self._global(mask.astype(bool)),
+            self._global(spec_mask.astype(bool)),
+        )
+        return (self._read(emitted), self._read(accepted),
+                self._read(logits0))
+
     def stop(self) -> None:
         """Leader: release the followers (end of serve). Idempotent —
         the serving layer calls this from ``close()`` UNDER the server
@@ -295,6 +321,14 @@ class SlicePagedKVCache(PagedKVCache):
             ))
             self._exec_window(params, np.asarray(tokens),
                               np.asarray(mask), a)
+        elif op == OP_SPEC:
+            tokens, mask, smask = self._bcast((
+                np.zeros((self.slots, a + 1), np.int32),
+                np.zeros((self.slots,), bool),
+                np.zeros((self.slots,), bool),
+            ))
+            self._exec_spec(params, np.asarray(tokens),
+                            np.asarray(mask), np.asarray(smask))
         else:  # pragma: no cover - protocol corruption is slice-fatal
             raise PagedCacheError(f"unknown slice-serve op {op}")
         return True

@@ -92,6 +92,12 @@ _SERVE_METRIC_FIELDS = (
     ("prefix_tokens_saved", "serve_prefix_tokens_saved_total", "counter",
      "prompt tokens whose prefill was skipped via prefix sharing "
      "(paged backend)"),
+    ("spec_passes", "serve_spec_passes_total", "counter",
+     "speculative verify passes run (paged backend, "
+     "serving_speculative > 0)"),
+    ("spec_emitted_per_pass", "serve_spec_emitted_per_pass", "gauge",
+     "mean greedy tokens emitted per verify pass — the realized "
+     "speculative acceleration (paged backend)"),
 )
 
 

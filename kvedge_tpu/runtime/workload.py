@@ -868,15 +868,18 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
     ), serve_fn
 
 
-def _serving_pool_dims(cfg, tcfg) -> tuple[int, int, int]:
-    """``(slots, pages, page_size)`` of the paged pool — ONE derivation
-    for the single-host server and the slice cache (the two must never
-    size differently). ``serving_pages = 0`` auto-sizes so every slot
-    can hold a worst-case request — admission then only ever waits on
-    slots, never on pages."""
+def _serving_pool_dims(cfg, tcfg) -> tuple[int, int, int, int]:
+    """``(slots, pages, page_size, max_pages_per_seq)`` of the paged
+    pool — ONE derivation for the single-host server and the slice
+    cache (the two must never size differently). ``serving_pages = 0``
+    auto-sizes so every slot can hold a worst-case request — admission
+    then only ever waits on slots, never on pages. Speculative mode
+    widens both by the draft slack (a verify pass writes K positions
+    past the budget even when nothing accepts)."""
     slots, page_size = cfg.serving_slots, cfg.serving_page_size
-    pages = cfg.serving_pages or slots * -(-tcfg.max_seq // page_size)
-    return slots, pages, page_size
+    mpps = -(-(tcfg.max_seq + cfg.serving_speculative) // page_size)
+    pages = cfg.serving_pages or slots * mpps
+    return slots, pages, page_size, mpps
 
 
 def _run_multihost_paged_serve(cfg, base, tcfg, mesh, restored_step,
@@ -909,9 +912,10 @@ def _run_multihost_paged_serve(cfg, base, tcfg, mesh, restored_step,
     # Constructed identically on EVERY process, at the same point in
     # the collective order (the zeroed global pool is a collective jit
     # execution).
-    slots, pages, page_size = _serving_pool_dims(cfg, tcfg)
+    slots, pages, page_size, mpps = _serving_pool_dims(cfg, tcfg)
     cache = SlicePagedKVCache(
         tcfg, slots=slots, pages=pages, page_size=page_size, mesh=mesh,
+        max_pages_per_seq=mpps,
     )
 
     if jax.process_index() != 0:
@@ -1064,8 +1068,10 @@ def _parse_generate_request(doc: dict, tcfg, *, max_rows: int,
             )
         if paged:
             raise ValueError(
-                "'speculative' runs on the contiguous backend; "
-                "this runtime serves [payload] serving = \"paged\""
+                "per-request 'speculative' runs on the contiguous "
+                "backend; the paged backend speculates server-wide "
+                "via [payload] serving_speculative (the batch-level "
+                "schedule is a server policy, not a request knob)"
             )
         if len(tokens) != 1:
             raise ValueError(
@@ -1176,12 +1182,13 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
             # page_size passed explicitly so the sizing arithmetic and
             # the cache's pages can never drift apart; an injected
             # cache carries its own pool from the SAME derivation.
-            slots, pages, page_size = _serving_pool_dims(cfg, tcfg)
+            slots, pages, page_size, _ = _serving_pool_dims(cfg, tcfg)
             paged_server = PagedGenerationServer(
                 params, tcfg, slots=slots, pages=pages,
                 page_size=page_size,
                 prefill_chunk=cfg.serving_prefill_chunk,
                 prefix_cache=cfg.serving_prefix_cache,
+                speculative=cfg.serving_speculative,
                 cache=cache,
             )
             # Prefix persistence (single-host only: the slice cache's

@@ -828,3 +828,123 @@ def test_prefix_cache_load_is_boot_time_only(params, tmp_path):
         assert server.load_prefix_cache(path, "fp-1") == 0
     finally:
         server.close()
+
+
+# ---- paged speculative decoding (round 4) --------------------------------
+
+
+def spec_server(params, **kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("pages", 60)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("speculative", 4)
+    return PagedGenerationServer(params, CFG, **kw)
+
+
+def test_spec_concurrent_requests_each_match_generate(params):
+    """The exactness bar, spec edition: concurrent ragged greedy
+    requests through verify passes — repetitive prompts (drafts accept)
+    and arbitrary ones (drafts reject) — each equal their own
+    contiguous decode, and the realized acceleration is observable."""
+    server = spec_server(params)
+    requests = [
+        ([5, 9, 2, 5, 9, 2, 5, 9], 12),  # bigram-repetitive: accepts
+        ([1, 7, 3], 8),
+        ([42, 17, 8, 99, 3, 2, 1], 10),
+        ([6, 6, 6, 6, 6], 9),            # constant: accepts heavily
+    ]
+    results: dict[int, list[int]] = {}
+    errors: list[Exception] = []
+
+    def worker(i, prompt, n_new):
+        try:
+            results[i] = server.submit(prompt, n_new)
+        except Exception as e:
+            errors.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=worker, args=(i, p, n))
+            for i, (p, n) in enumerate(requests)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        for i, (p, n) in enumerate(requests):
+            assert results[i] == reference(params, p, n), i
+        stats = server.stats()
+        assert stats["spec_passes"] > 0
+        assert stats["spec_emitted_per_pass"] >= 1.0
+    finally:
+        server.close()
+
+
+def test_spec_budget_edge_and_page_boundaries(params):
+    """Acceptance overshooting the budget truncates exactly at n_new
+    (the client never sees overshoot tokens), including when the verify
+    window crosses page boundaries and when prompt + n_new == max_seq
+    (the draft slack must not shrink the servable request space)."""
+    server = spec_server(params, slots=2)
+    try:
+        # Constant prompt accepts aggressively; tiny budgets must cut
+        # exactly.
+        for n_new in (1, 2, 3, 5):
+            p = [6, 6, 6, 6]
+            assert server.submit(p, n_new) == reference(params, p, n_new)
+        # Full-length request: prompt + n_new == max_seq (64).
+        p = [3, 1, 4, 1, 5, 9, 2, 6] * 5  # 40 tokens
+        assert server.submit(p, 24) == reference(params, p, 24)
+    finally:
+        server.close()
+
+
+def test_spec_sampled_rides_verify_pass_exactly(params):
+    """A sampled request concurrent with greedy spec traffic advances
+    one token per pass with the SAME key schedule as the per-step path
+    — tokens equal a non-speculative paged server's."""
+    import jax
+
+    key = jax.random.fold_in(jax.random.PRNGKey(7), 0)
+    sampling = (key, jnp.float32(0.8), jnp.float32(0.9))
+    prompt_s, prompt_g = [9, 8, 7], [5, 9, 2, 5, 9, 2]
+
+    plain = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                  page_size=4)
+    try:
+        want_sampled = plain.submit(prompt_s, 6, sampling=sampling)
+    finally:
+        plain.close()
+
+    server = spec_server(params, slots=2)
+    results: dict = {}
+    try:
+        t = threading.Thread(
+            target=lambda: results.update(
+                g=server.submit(prompt_g, 8)
+            )
+        )
+        t.start()
+        results["s"] = server.submit(prompt_s, 6, sampling=sampling)
+        t.join(timeout=300)
+        assert results["s"] == want_sampled
+        assert results["g"] == reference(params, prompt_g, 8)
+    finally:
+        server.close()
+
+
+def test_spec_composes_with_prefix_sharing_and_streaming(params):
+    """Spec mode + prefix reuse + streaming: the second (shared-prefix,
+    streamed) request still matches contiguous decode token for token."""
+    server = spec_server(params, slots=2)
+    try:
+        base = [7, 3, 9, 1, 5, 5, 2, 8]
+        first = server.submit(base + [4, 6], n_new=6)
+        assert first == reference(params, base + [4, 6], 6)
+        streamed = list(server.submit_stream(base + [9, 9], n_new=6))
+        assert (base + [9, 9] + streamed
+                == reference(params, base + [9, 9], 6))
+        assert server.stats()["prefix_hits"] == 1
+    finally:
+        server.close()

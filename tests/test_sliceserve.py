@@ -205,6 +205,28 @@ def test_hard_close_mid_request_and_double_close_do_not_hang(
     assert server._cache._stopped
 
 
+def test_slice_server_speculative_matches_reference(params, mesh):
+    """Speculative mode over the slice cache: verify passes broadcast
+    as OP_SPEC ops; tokens still equal the contiguous decode, and the
+    acceleration is realized (repetitive prompt accepts drafts)."""
+    cache = SlicePagedKVCache(
+        CFG, slots=2, pages=40, page_size=4, mesh=mesh,
+        max_pages_per_seq=-(-(CFG.max_seq + 4) // 4),
+    )
+    server = PagedGenerationServer(params, CFG, cache=cache,
+                                   speculative=4)
+    try:
+        prompt = [5, 9, 2, 5, 9, 2, 5, 9]
+        assert server.submit(prompt, n_new=12) == reference(
+            params, prompt, 12
+        )
+        stats = server.stats()
+        assert stats["spec_passes"] > 0
+        assert stats["spec_emitted_per_pass"] > 1.0  # drafts accepted
+    finally:
+        server.close()
+
+
 def test_slice_server_prefix_sharing_stays_exact(params, mesh):
     """The prefix registry (host-only leader state) composes with the
     slice cache: a repeated prompt reuses pinned pages and still decodes
