@@ -136,6 +136,12 @@ class ModelSpec:
     expert_top_k: int = 0  # 0 = 1 (Switch); 2 = GShard top-2
     # 0.0 = provably drop-free capacity (factor * top_k >= experts).
     expert_capacity_factor: float = 0.0
+    # Pipeline backward schedule when the mesh has a ``stage`` axis:
+    # "" / "gpipe" = GPipe + remat (general — composes with MoE and
+    # sequence parallelism); "1f1b" = the fused 1F1B schedule with an
+    # O(stages) activation stash (dense models, standard attention —
+    # parallel/pipeline1f1b.py documents the refusals).
+    pipeline_schedule: str = ""
 
     def validate(self) -> None:
         if self.preset not in _VALID_PRESETS:
@@ -160,6 +166,11 @@ class ModelSpec:
         if self.expert_top_k not in (0, 1, 2):
             raise RuntimeConfigError(
                 "[model] expert_top_k must be 1 or 2 (0 = default 1)"
+            )
+        if self.pipeline_schedule not in ("", "gpipe", "1f1b"):
+            raise RuntimeConfigError(
+                "[model] pipeline_schedule must be 'gpipe' or '1f1b' "
+                "('' = gpipe)"
             )
 
 
@@ -344,6 +355,10 @@ class RuntimeConfig:
                         model_doc.get("expert_capacity_factor",
                                       ModelSpec.expert_capacity_factor)
                     ),
+                    pipeline_schedule=str(
+                        model_doc.get("pipeline_schedule",
+                                      ModelSpec.pipeline_schedule)
+                    ),
                 ),
                 distributed=DistributedSpec(
                     num_processes=int(
@@ -525,6 +540,7 @@ class RuntimeConfig:
             f"experts = {self.model.experts}\n"
             f"expert_top_k = {self.model.expert_top_k}\n"
             f"expert_capacity_factor = {self.model.expert_capacity_factor}\n"
+            f"pipeline_schedule = {s(self.model.pipeline_schedule)}\n"
             "\n[distributed]\n"
             f"num_processes = {self.distributed.num_processes}\n"
             f"coordinator_address = {s(self.distributed.coordinator_address)}\n"

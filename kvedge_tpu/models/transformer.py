@@ -76,6 +76,13 @@ class TransformerConfig:
     # Microbatches per step under pipelining; 0 = one per stage. More
     # microbatches shrink the pipeline bubble (M / (M + S - 1)).
     pipeline_microbatches: int = 0
+    # Pipeline backward schedule: "gpipe" (autodiff through the forward
+    # schedule + remat — general, composes with MoE/seq-parallel) or
+    # "1f1b" (the fused forward+backward schedule with an O(stages)
+    # activation stash — dense models, standard attention;
+    # parallel/pipeline1f1b.py). Training-only: inference never
+    # differentiates, so decode/serve paths ignore it.
+    pipeline_schedule: str = "gpipe"
     # Fused cross-entropy readout (ops/xent.py): the training loss skips
     # materializing [B*T, V] logits entirely — blockwise Pallas matmuls
     # with an online logsumexp and an LSE-recompute backward. Measured on
@@ -172,6 +179,32 @@ class TransformerConfig:
                 f"n_layers {self.n_layers} must divide by "
                 f"pipeline_stages {self.pipeline_stages}"
             )
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                "pipeline_schedule must be 'gpipe' or '1f1b', got "
+                f"{self.pipeline_schedule!r}"
+            )
+        if self.pipeline_schedule == "1f1b":
+            # Config-time refusals (loud at derive/validate, not at the
+            # first train step) — parallel/pipeline1f1b.py's docstring
+            # carries the reasons.
+            if self.n_experts:
+                raise ValueError(
+                    "pipeline_schedule='1f1b' does not support MoE "
+                    "layers (use 'gpipe')"
+                )
+            if self.attention in ("ring", "ulysses"):
+                raise ValueError(
+                    "pipeline_schedule='1f1b' does not compose with "
+                    "sequence-parallel attention (use 'gpipe')"
+                )
+            if self.fused_xent:
+                raise ValueError(
+                    "pipeline_schedule='1f1b' computes its loss head "
+                    "inside the pipeline's manual region, where the "
+                    "Pallas fused-xent kernel cannot run (use 'gpipe' "
+                    "or disable fused_xent)"
+                )
 
 
 # Named model shapes for the runtime's [model] TOML section. One
@@ -619,9 +652,31 @@ def make_train_step(cfg: TransformerConfig, optimizer=None, mesh=None):
     def init_opt_state(params):
         return optimizer.init(params)
 
+    use_1f1b = cfg.pipeline_stages > 1 and cfg.pipeline_schedule == "1f1b"
+    if use_1f1b:
+        from kvedge_tpu.parallel.pipeline1f1b import (
+            pipeline_1f1b_loss_and_grads,
+        )
+
+        if mesh is None:
+            raise ValueError(
+                "pipeline_schedule='1f1b' needs the mesh passed to "
+                "make_train_step()"
+            )
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        if use_1f1b:
+            # The fused 1F1B schedule builds the backward itself —
+            # autodiff cannot produce a 1F1B schedule from a forward
+            # scan (parallel/pipeline1f1b.py).
+            loss, grads = pipeline_1f1b_loss_and_grads(
+                params, batch, cfg, mesh
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, batch, cfg, mesh
+            )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -170,6 +170,7 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
         expert_top_k=top_k,
         expert_capacity_factor=float(capacity),
         pipeline_stages=stages if stages > 1 else 0,
+        pipeline_schedule=spec.pipeline_schedule or "gpipe",
     )
     try:
         # Cross-field architecture errors (d_model % n_heads, GQA head

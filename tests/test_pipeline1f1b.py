@@ -1,0 +1,163 @@
+"""1F1B fused pipeline schedule (parallel/pipeline1f1b.py).
+
+Core property: gradient parity — the hand-built forward+backward
+schedule must produce the SAME loss and gradients as ``jax.grad``
+through the GPipe path (which is itself pinned against the unsharded
+model in test_pipeline.py), on every supported mesh family. Plus the
+memory claim the schedule exists for: bounded in-flight stash means the
+compiled backward's peak temp memory stays flat as microbatches grow at
+fixed per-microbatch size, where GPipe's grows with M.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kvedge_tpu.config.runtime_config import MeshSpec
+from kvedge_tpu.models import TransformerConfig, init_params
+from kvedge_tpu.models.transformer import loss_fn, make_train_step
+from kvedge_tpu.parallel import build_mesh, shard_batch, shard_params
+from kvedge_tpu.parallel.pipeline1f1b import pipeline_1f1b_loss_and_grads
+
+CFG = TransformerConfig(
+    vocab=64, d_model=16, n_heads=2, n_kv_heads=2, n_layers=4, d_ff=32,
+    max_seq=16, dtype="float32", pipeline_stages=2,
+    pipeline_microbatches=4, pipeline_schedule="1f1b",
+)
+
+MESHES = {
+    "pp2": ((("data", 1), ("stage", 2)), 2),
+    "dp2-pp4": ((("data", 2), ("stage", 4)), 8),
+    "dp4-pp2": ((("data", 4), ("stage", 2)), 8),
+    "dp2-pp2-tp2": ((("data", 2), ("stage", 2), ("model", 2)), 8),
+}
+
+
+def _setup(axes, ndev, **over):
+    stages = dict(axes)["stage"]
+    cfg = dataclasses.replace(
+        CFG, pipeline_stages=stages, n_layers=2 * stages, **over
+    )
+    mesh = build_mesh(MeshSpec(axes=axes), devices=jax.devices()[:ndev])
+    params = shard_params(mesh, init_params(jax.random.PRNGKey(0), cfg))
+    batch = jax.random.randint(
+        jax.random.PRNGKey(1), (16, 17), 0, cfg.vocab, dtype=jnp.int32
+    )
+    return cfg, mesh, params, batch
+
+
+@pytest.mark.parametrize("axes,ndev", MESHES.values(), ids=MESHES.keys())
+def test_gradient_parity_with_gpipe_autodiff(axes, ndev):
+    """Loss and every gradient equal jax.grad of the GPipe path —
+    including with a tensor-parallel model axis (automatic inside the
+    schedule's vjp, exactly as inside GPipe's forward)."""
+    cfg, mesh, params, batch = _setup(axes, ndev)
+    gpipe_cfg = dataclasses.replace(cfg, pipeline_schedule="gpipe")
+    loss_g, grads_g = jax.value_and_grad(loss_fn)(
+        params, batch, gpipe_cfg, mesh
+    )
+    loss_f, grads_f = pipeline_1f1b_loss_and_grads(
+        params, batch, cfg, mesh
+    )
+    assert abs(float(loss_g) - float(loss_f)) < 1e-5
+    for name in grads_g:
+        a, b = np.asarray(grads_g[name]), np.asarray(grads_f[name])
+        err = np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-12)
+        assert err < 1e-4, (name, err)
+
+
+def test_train_step_uses_the_schedule_and_trains(tmp_path):
+    """make_train_step routes pipeline_schedule='1f1b' onto the fused
+    schedule; a few optimizer steps reduce the loss and track the GPipe
+    twin's trajectory (same optimizer, same batches)."""
+    cfg, mesh, params, batch = _setup((("data", 2), ("stage", 4)), 8)
+    gpipe_cfg = dataclasses.replace(cfg, pipeline_schedule="gpipe")
+
+    def run(c):
+        p = shard_params(mesh, init_params(jax.random.PRNGKey(0), c))
+        init_opt, step = make_train_step(c, mesh=mesh)
+        opt = init_opt(p)
+        losses = []
+        for i in range(4):
+            b = shard_batch(mesh, jax.random.randint(
+                jax.random.PRNGKey(10 + i), (16, 17), 0, c.vocab,
+                dtype=jnp.int32,
+            ))
+            p, opt, loss = step(p, opt, b)
+            losses.append(float(loss))
+        return losses
+
+    l_f = run(cfg)
+    l_g = run(gpipe_cfg)
+    # Trajectory identity is the check (4 random-token steps don't
+    # reliably descend): every step's loss equals the GPipe twin's, so
+    # the schedules' optimizer trajectories are the same trajectory.
+    np.testing.assert_allclose(l_f, l_g, rtol=1e-4)
+    assert len(set(round(x, 6) for x in l_f)) > 1  # params actually move
+
+
+def test_refusals_are_config_time():
+    for over, msg in (
+        (dict(n_experts=2), "MoE"),
+        (dict(attention="ring"), "sequence-parallel"),
+        (dict(fused_xent=True), "fused-xent"),
+    ):
+        with pytest.raises(ValueError, match=msg):
+            dataclasses.replace(CFG, **over).validate()
+
+
+def _compiled_temp_bytes(schedule: str, micro: int) -> int:
+    """Peak temp bytes of one compiled grad computation, at FIXED
+    per-microbatch size (batch grows with micro — the regime where
+    GPipe's stash grows and 1F1B's stays bounded)."""
+    import functools
+
+    stages = 2
+    cfg = dataclasses.replace(
+        CFG, pipeline_stages=stages, n_layers=2 * stages,
+        pipeline_microbatches=micro, pipeline_schedule=schedule,
+    )
+    mesh = build_mesh(
+        MeshSpec(axes=(("data", 1), ("stage", 2))),
+        devices=jax.devices()[:2],
+    )
+    params = shard_params(mesh, init_params(jax.random.PRNGKey(0), cfg))
+    batch = jax.random.randint(
+        jax.random.PRNGKey(1), (4 * micro, 17), 0, cfg.vocab,
+        dtype=jnp.int32,
+    )
+    if schedule == "1f1b":
+        fn = functools.partial(
+            pipeline_1f1b_loss_and_grads, cfg=cfg, mesh=mesh
+        )
+        compiled = jax.jit(
+            lambda p, b: fn(p, b)[1]
+        ).lower(params, batch).compile()
+    else:
+        compiled = jax.jit(jax.grad(functools.partial(
+            loss_fn, cfg=cfg, mesh=mesh
+        ))).lower(params, batch).compile()
+    return compiled.memory_analysis().temp_size_in_bytes
+
+
+def test_memory_stash_is_bounded_in_microbatches():
+    """The claim the schedule exists for: growing M at fixed
+    per-microbatch size grows GPipe+remat's temp memory (its backward
+    carries O(M) state) much faster than 1F1B's (O(S) stash + the O(M)
+    data terms every schedule pays). Asserted as a RATIO between the
+    two schedules' growth, not absolutes — compiler versions move
+    absolute numbers."""
+    s = 2
+    one_s = _compiled_temp_bytes("1f1b", micro=2 * s)
+    one_4s = _compiled_temp_bytes("1f1b", micro=8 * s)
+    gp_s = _compiled_temp_bytes("gpipe", micro=2 * s)
+    gp_4s = _compiled_temp_bytes("gpipe", micro=8 * s)
+    growth_1f1b = one_4s / one_s
+    growth_gpipe = gp_4s / gp_s
+    assert growth_1f1b < growth_gpipe, (
+        f"1f1b grew {growth_1f1b:.2f}x vs gpipe {growth_gpipe:.2f}x "
+        f"({one_s}->{one_4s} vs {gp_s}->{gp_4s})"
+    )
