@@ -6,6 +6,7 @@ on 8 virtual CPU devices per the build environment contract. See
 jax.config, before any backend init) is load-bearing.
 """
 
+import os
 import pathlib
 import shutil
 import subprocess
@@ -17,6 +18,26 @@ from kvedge_tpu.testing.jaxenv import force_virtual_cpu_devices
 force_virtual_cpu_devices(8)
 
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+
+# One process compiling the whole ~660-test suite accumulates XLA state
+# (jit caches + loaded executables) until XLA's compiler segfaulted at
+# ~619 tests — reproducibly, with 125 GB free (VERDICT.md r4 weak #1).
+# Bound the live population: clear JAX's compilation caches every N
+# tests. Module-level jitted wrappers (e.g. kvcache._paged_decode_step)
+# keep working — their cache entries just recompile on next use. The
+# committed tools/run_tests.py sharded runner is the stronger guarantee
+# (fresh process per ≤250 tests); this keeps the plain
+# ``python -m pytest tests`` invocation viable too.
+_CLEAR_EVERY = int(os.environ.get("KVEDGE_CLEAR_CACHES_EVERY", "150"))
+_test_counter = {"n": 0}
+
+
+def pytest_runtest_teardown(item, nextitem):
+    _test_counter["n"] += 1
+    if _CLEAR_EVERY > 0 and _test_counter["n"] % _CLEAR_EVERY == 0:
+        import jax
+
+        jax.clear_caches()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,204 @@
+"""Process-sharded test runner: the ONE command that runs the whole net.
+
+``python -m pytest tests`` accumulates XLA backend state — compiled
+executables, jit caches, the 8-virtual-device CPU client — across ~660
+tests in one process, and XLA's compiler reproducibly segfaulted after
+~619 of them (twice, same site, 125 GB free RAM — not OOM; see
+VERDICT.md round 4 "What's weak" #1). Every file passes in isolation,
+so the failure is an at-scale artifact of one process compiling 600+
+programs, not a test bug. Two defenses exist:
+
+* ``tests/conftest.py`` clears JAX's compilation caches every
+  ``KVEDGE_CLEAR_CACHES_EVERY`` tests (default 150), which bounds the
+  live-executable population and lets the plain pytest invocation
+  finish on this box;
+* this runner is the belt to that suspender: it bin-packs test FILES
+  into shards of at most ``--max-tests`` tests (default 250 — well
+  under the ~619 observed crash horizon) and runs each shard in a
+  FRESH python process, so no process ever approaches the
+  accumulation regime regardless of what upstream XLA does.
+
+Usage::
+
+    python tools/run_tests.py            # full suite, sharded
+    python tools/run_tests.py -k serving # filtered, still sharded
+    python tools/run_tests.py --list     # show the shard plan only
+
+Prints a per-shard progress line and ONE aggregate summary; exits 0
+iff every shard passed (pytest exit 0). Runtime on this box (1 CPU,
+8 virtual JAX devices): ~35-45 min for the full suite — compilation
+dominates, and fresh processes re-pay imports (~8 s each), which is
+the price of bounded accumulation.
+
+The reference has no tests at all (SURVEY.md §4); the suite — and the
+need for a runner that can actually haul it in — is this repo's own.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import re
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TESTS = REPO / "tests"
+
+# Pytest summary tokens we aggregate (the trailing "=== N passed, ... ==="
+# line); "error" covers collection errors, which must fail the run.
+_SUMMARY_RE = re.compile(
+    r"(\d+) (passed|failed|skipped|error|errors|xfailed|xpassed)"
+)
+
+
+def split_args(pytest_args: list[str]) -> tuple[list[str], list[str]]:
+    """(positional path targets, option args) — paths narrow what gets
+    collected and are NOT re-forwarded to shard runs (the shard file
+    lists already reflect them; forwarding would re-run them in every
+    shard)."""
+    paths = [a for a in pytest_args if os.path.exists(a)]
+    opts = [a for a in pytest_args if not os.path.exists(a)]
+    return paths, opts
+
+
+def collect_counts(pytest_args: list[str]) -> dict[str, int]:
+    """Per-file test counts from one fresh collect-only process.
+
+    Collection imports every test module but compiles nothing, so it is
+    safe to do in a single process; ``-q`` collect output ends with
+    ``N tests collected`` lines per ``--co`` format — we count test ids
+    per file instead, which is stable across pytest versions.
+    """
+    paths, opts = split_args(pytest_args)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         *(paths or [str(TESTS)]), "--collect-only", "-q", *opts],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    if proc.returncode not in (0, 5):  # 5 = nothing collected (ok for -k)
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise SystemExit(f"test collection failed (exit {proc.returncode})")
+    counts: dict[str, int] = {}
+    for line in proc.stdout.splitlines():
+        # test ids look like "tests/test_x.py::TestC::test_y[param]"
+        if "::" not in line:
+            continue
+        path = line.split("::", 1)[0].strip()
+        if path.endswith(".py"):
+            counts[path] = counts.get(path, 0) + 1
+    return counts
+
+
+def plan_shards(counts: dict[str, int], max_tests: int
+                ) -> list[tuple[list[str], int]]:
+    """Bin-pack files (in name order — deterministic) into shards of at
+    most ``max_tests`` tests. A single file larger than the cap gets a
+    shard of its own: files are the process-isolation granule, and no
+    current file is near the crash horizon (largest ~90 tests)."""
+    shards: list[tuple[list[str], int]] = []
+    cur: list[str] = []
+    cur_n = 0
+    for path in sorted(counts):
+        n = counts[path]
+        if cur and cur_n + n > max_tests:
+            shards.append((cur, cur_n))
+            cur, cur_n = [], 0
+        cur.append(path)
+        cur_n += n
+    if cur:
+        shards.append((cur, cur_n))
+    return shards
+
+
+def run_shard(files: list[str], pytest_args: list[str]) -> tuple[int, dict]:
+    """One fresh-process pytest run over ``files``. Returns
+    (exit code, summary counts)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", *files, "-q", "--tb=short",
+         *pytest_args],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    tally: dict[str, int] = {}
+    # The summary line is the last one matching the token pattern.
+    for line in proc.stdout.splitlines():
+        found = _SUMMARY_RE.findall(line)
+        if found:
+            tally = {}
+            for num, kind in found:
+                kind = "error" if kind == "errors" else kind
+                tally[kind] = tally.get(kind, 0) + int(num)
+    if proc.returncode not in (0, 5) or not tally:
+        # Failure (or a crash that never printed a summary): surface the
+        # shard's full output so the failing test is identifiable.
+        sys.stderr.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+    return proc.returncode, tally
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--max-tests", type=int, default=250,
+                    help="max tests per fresh process (default 250)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the shard plan and exit")
+    ap.add_argument("pytest_args", nargs="*",
+                    help="extra args forwarded to pytest (e.g. -k expr)")
+    args, unknown = ap.parse_known_args(argv)
+    args.pytest_args = unknown + args.pytest_args
+
+    counts = collect_counts(args.pytest_args)
+    if not counts:
+        print("no tests collected")
+        return 5
+    shards = plan_shards(counts, args.max_tests)
+    total_planned = sum(n for _, n in shards)
+    print(f"{total_planned} tests in {len(counts)} files -> "
+          f"{len(shards)} shards (max {args.max_tests} tests/process)")
+    if args.list:
+        for i, (files, n) in enumerate(shards):
+            print(f"  shard {i + 1}: {n:4d} tests  "
+                  f"{files[0]} .. {files[-1]} ({len(files)} files)")
+        return 0
+
+    _, opts = split_args(args.pytest_args)
+    t0 = time.monotonic()
+    totals: dict[str, int] = {}
+    failed_shards: list[int] = []
+    for i, (files, n) in enumerate(shards):
+        st = time.monotonic()
+        code, tally = run_shard(files, opts)
+        dt = time.monotonic() - st
+        for k, v in tally.items():
+            totals[k] = totals.get(k, 0) + v
+        status = "ok" if code == 0 else f"FAILED (exit {code})"
+        if code != 0:
+            failed_shards.append(i + 1)
+        summary = ", ".join(
+            f"{v} {k}" for k, v in sorted(tally.items())
+        ) or "no summary"
+        print(f"shard {i + 1}/{len(shards)}: {status} — {summary} "
+              f"[{n} planned, {dt:.0f}s, "
+              f"{files[0]}..{files[-1]}]", flush=True)
+
+    elapsed = time.monotonic() - t0
+    grand = ", ".join(f"{v} {k}" for k, v in sorted(totals.items()))
+    ran = sum(v for k, v in totals.items() if k != "error")
+    print(f"TOTAL: {grand} in {elapsed:.0f}s "
+          f"({ran}/{total_planned} collected tests accounted for)")
+    if failed_shards:
+        print(f"FAILED shards: {failed_shards}")
+        return 1
+    if ran < total_planned:
+        # A crashed process can exit 0-adjacent without a summary; never
+        # report green unless every planned test is accounted for.
+        print("FAILED: some planned tests never reported a result")
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
