@@ -214,6 +214,11 @@ def measure_decode(cfg, batch: int, prompt_len: int, n_new: int):
 
 PAGED_SLOTS = 4
 PAGED_PAGE_SIZE = 16
+# The serving_window default: steps per dispatched decode scan. Round 5
+# decoupled the window from page_size (VERDICT r4 #2) — one host round
+# trip now amortizes over 64 greedy tokens, not 16, which is what keeps
+# paged decode near its device rate even on a ~100 ms-RTT relay.
+PAGED_WINDOW = 64
 
 
 def measure_relay_rtt(samples: int = 20) -> float:
@@ -238,16 +243,18 @@ def measure_relay_rtt(samples: int = 20) -> float:
 
 
 def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
-                         page_size: int):
+                         page_size: int, window: int = PAGED_WINDOW):
     """Continuous-batching decode: (tokens/s, steps/s, hostloop steps/s).
 
     VERDICT r2 #5 added the paged measurement; VERDICT r3 #2 moved the
-    production loop onto device-side windows. All ``slots`` sequences
-    are admitted + prefilled (full occupancy — the server's steady state
-    under load), then ``n_new`` decode steps run exactly as the serving
-    loop runs them for greedy traffic: ``cache.step_window`` scans
-    ``page_size`` steps per dispatch with on-device argmax feedback, one
-    host transfer per window. The third number re-times the same steps
+    production loop onto device-side windows; VERDICT r4 #2 widened the
+    window past page_size. All ``slots`` sequences are admitted +
+    prefilled (full occupancy — the server's steady state under load),
+    then ``n_new`` decode steps run exactly as the serving loop runs
+    them for greedy traffic: ``cache.step_window`` scans up to
+    ``window`` steps per dispatch (power-of-two floored, the server's
+    program-set discipline) with on-device argmax feedback, one host
+    transfer per window. The third number re-times the same steps
     through per-step ``cache.step`` dispatches — the path sampled slots
     still take, and the round-3 baseline the window is measured against.
     """
@@ -272,15 +279,18 @@ def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
         return tokens
 
     def run_windowed(cache) -> float:
-        """The production greedy path: page_size-step device windows,
-        one host transfer of the window's tokens per dispatch — exactly
-        what the serving loop consumes to emit tokens and check
-        budgets."""
+        """The production greedy path: multi-page device windows
+        (power-of-two floored at the remaining budget, exactly the
+        server's _window_steps discipline), one host transfer of the
+        window's tokens per dispatch — what the serving loop consumes
+        to emit tokens and check budgets."""
         tokens = prefill(cache)
         start = time.perf_counter()
         remaining = n_new
         while remaining:
-            w = min(page_size, remaining)
+            w = min(window, remaining)
+            if w > 1:
+                w = 1 << (w.bit_length() - 1)
             produced = cache.step_window(params, tokens, w)
             np.asarray(produced)  # the serving loop emits these
             tokens = produced[w - 1]
@@ -369,6 +379,8 @@ def measure_paged_spec(cfg, slots: int, prompt_len: int, n_new: int,
         while any(len(r.generated) < n_new for r in reqs):
             tokens = np.zeros((slots, draft_len + 1), np.int32)
             for s, r in enumerate(reqs):
+                if not active[s]:
+                    continue
                 tokens[s, 0] = r.next_token
                 tokens[s, 1:] = PagedGenerationServer._draft(
                     r, draft_len
@@ -379,12 +391,21 @@ def measure_paged_spec(cfg, slots: int, prompt_len: int, n_new: int,
             emitted = np.asarray(emitted)
             passes += 1
             for s, r in enumerate(reqs):
+                if not active[s]:
+                    continue
                 a = int(accepted[s])
                 seq = [r.next_token] + [int(t) for t in emitted[s, :a]]
                 room = n_new - len(r.generated)
                 r.generated.extend(seq[:room])
                 r.next_token = (seq[room] if room < len(seq)
                                 else int(emitted[s, a]))
+                if len(r.generated) >= n_new:
+                    # Deactivate finished rows, matching the serving
+                    # loop: they must stop advancing device lengths, or
+                    # heterogeneous-prompt runs would eventually hit
+                    # max_pages_per_seq (and skew the timing).
+                    active[s] = False
+                    spec_mask[s] = False
         elapsed = time.perf_counter() - start
         for s in range(slots):
             cache.release(s)
@@ -580,6 +601,7 @@ def main() -> int:
                     paged_host_sps, 1
                 ),
                 "paged_decode_slots": PAGED_SLOTS,
+                "paged_decode_window": PAGED_WINDOW,
                 # Batched speculative serving (serving_speculative=4)
                 # on the same favorable repetitive input as the
                 # single-row spec metrics: one verify pass advances

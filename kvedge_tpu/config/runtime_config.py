@@ -103,6 +103,16 @@ class MeshSpec:
 _VALID_PRESETS = ("", "probe", "flagship")
 
 
+def _parse_speculative(value):
+    """``serving_speculative``: an int draft length or the string
+    "auto" (resolved at serve boot by the relay-economics probe,
+    models/serving.py resolve_speculation). Type errors surface in
+    validate() with the full accepted-values message."""
+    if isinstance(value, str):
+        return value  # validate() accepts only "auto"
+    return int(value)
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelSpec:
     """The payload model's architecture ([model] TOML section).
@@ -273,15 +283,28 @@ class RuntimeConfig:
     # step + model geometry): a cache from different params is ignored,
     # never half-trusted. Single-host paged backend only.
     serving_prefix_persist: bool = True
+    # Device-side decode window cap for the paged backend: up to this
+    # many greedy steps run in ONE dispatched scan (one host round trip
+    # per window instead of per token — the knob that decouples decode
+    # throughput from the relay RTT). Compiled programs stay the powers
+    # of two {2..serving_window}. Tradeoff: a new request joins at the
+    # next window boundary, so admission latency grows with the window
+    # (SERVING.md's performance model). 1 = per-step dispatch.
+    serving_window: int = 64
     # Server-wide speculative decoding for the paged backend: draft
-    # length K (0 = off). Greedy traffic advances by batched verify
-    # passes — K prompt-lookup drafts per slot, up to K+1 tokens per
-    # slot per model forward, token-for-token identical to plain
+    # length K (0 = off), or "auto". Greedy traffic advances by batched
+    # verify passes — K prompt-lookup drafts per slot, up to K+1 tokens
+    # per slot per model forward, token-for-token identical to plain
     # greedy decode (drafts accept only where they equal the model's
     # own argmax). Pays where decode is weight-bandwidth-bound: see
-    # SPEC_CROSSOVER_r04.json for the model-size crossover. Each
-    # request's page budget grows by K slack positions.
-    serving_speculative: int = 0
+    # SPEC_CROSSOVER_r04.json for the model-size crossover. GREEDY
+    # requests' page budgets grow by K slack positions (sampled ones
+    # can never accept a draft and reserve nothing extra). "auto"
+    # probes the relay at serve boot (draft length 4) and turns
+    # speculation off when windowed decode dominates its best case;
+    # an explicit K keeps the operator's choice but logs a loud
+    # warning under the same test (single-host serve only).
+    serving_speculative: int | str = 0
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -407,7 +430,10 @@ class RuntimeConfig:
                 serving_prefix_persist=payload_doc.get(
                     "serving_prefix_persist", cls.serving_prefix_persist
                 ),
-                serving_speculative=int(
+                serving_window=int(
+                    payload_doc.get("serving_window", cls.serving_window)
+                ),
+                serving_speculative=_parse_speculative(
                     payload_doc.get("serving_speculative",
                                     cls.serving_speculative)
                 ),
@@ -481,10 +507,18 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving_prefix_persist must be a boolean"
             )
-        if not 0 <= self.serving_speculative <= 16:
+        if not 1 <= self.serving_window <= 1024:
+            raise RuntimeConfigError(
+                "[payload] serving_window must be in [1, 1024] "
+                "(1 = per-step dispatch)"
+            )
+        if self.serving_speculative != "auto" and not (
+            isinstance(self.serving_speculative, int)
+            and 0 <= self.serving_speculative <= 16
+        ):
             raise RuntimeConfigError(
                 "[payload] serving_speculative (draft length) must be "
-                "in [0, 16] (0 = off)"
+                "in [0, 16] (0 = off) or 'auto'"
             )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
@@ -562,7 +596,9 @@ class RuntimeConfig:
             f"{'true' if self.serving_prefix_cache else 'false'}\n"
             "serving_prefix_persist = "
             f"{'true' if self.serving_prefix_persist else 'false'}\n"
-            f"serving_speculative = {self.serving_speculative}\n"
+            f"serving_window = {self.serving_window}\n"
+            "serving_speculative = "
+            f"{s(self.serving_speculative) if isinstance(self.serving_speculative, str) else self.serving_speculative}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

@@ -254,15 +254,25 @@ class PagedKVCache:
 
     # ---- data plane (device) --------------------------------------------
 
+    def snapshot_pages(self, ids: list[int]):
+        """DEVICE copies of the K/V data in ``ids``: two fresh arrays
+        ``[L, n, page, K, Dh]`` (one gather per pool). The split that
+        lets the periodic dump hold the serving lock only for the
+        gather dispatch: the fresh arrays are immune to the decode
+        step's buffer donation, so the (much slower) device->host
+        transfer happens OUTSIDE the lock without racing a step that
+        would invalidate the pool buffers."""
+        idx = jnp.asarray(ids, jnp.int32)
+        return self.state.pool_k[:, idx], self.state.pool_v[:, idx]
+
     def read_pages(self, ids: list[int]):
         """Host copies of the K/V data in ``ids``: two arrays
         ``[L, n, page, K, Dh]``. One gather + transfer per pool — the
         prefix-persistence dump path (models/serving.py)."""
         import numpy as np
 
-        idx = jnp.asarray(ids, jnp.int32)
-        return (np.asarray(self.state.pool_k[:, idx]),
-                np.asarray(self.state.pool_v[:, idx]))
+        k_dev, v_dev = self.snapshot_pages(ids)
+        return np.asarray(k_dev), np.asarray(v_dev)
 
     def write_pages(self, ids: list[int], k_vals, v_vals) -> None:
         """Scatter K/V data ([L, n, page, K, Dh]) into pages ``ids`` —
@@ -430,19 +440,24 @@ class PagedKVCache:
 
         ``tokens`` [slots, 1+K] int32; ``spec_mask`` [slots] bool marks
         rows whose drafts may accept (greedy rows — sampled rows ride
-        with acceptance 0). Pages for the worst case (all K drafts
-        accepted) are grown up front — legal because the serving layer
-        reserves each speculative request's slack budget at admission.
-        Returns ``(emitted [slots, K+1], accepted [slots] np.int64,
-        logits0 [slots, V])``.
+        with acceptance 0 and their draft scatters dropped). Greedy
+        rows grow pages for the worst case (all K drafts accepted) up
+        front — legal because the serving layer reserves each
+        SPECULATIVE request's slack budget at admission; sampled rows
+        grow one position only, exactly like a plain step, so they
+        carry no slack reservation. Returns ``(emitted [slots, K+1],
+        accepted [slots] np.int64, logits0 [slots, V])``.
         """
         import numpy as _np
 
         slots = self._step_slots(active)
+        spec_np = _np.asarray(spec_mask, bool)
         k_len = tokens.shape[1] - 1
         grew = False
         for slot in slots:
-            grew |= self.grow_to(slot, k_len + 1)
+            grew |= self.grow_to(
+                slot, (k_len + 1) if spec_np[slot] else 1
+            )
         if grew:
             self._sync()
         emitted, accepted, logits0 = self._device_spec(
@@ -499,10 +514,14 @@ def _scatter_token(pool, tables, lengths, kv_new, active):
 
 
 def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
-                        layer_params, layer_slabs, q_positions, slot=None):
+                        layer_params, layer_slabs, q_positions, slot=None,
+                        write_mask=None):
     """Shared block body. x: [B, Q, D]; q_positions: [B, Q] absolute
     positions of the new tokens. ``slot`` non-None = single-sequence
-    prefill (B == 1 view of that slot)."""
+    prefill (B == 1 view of that slot). ``write_mask`` [B, Q] bool
+    (batched paths only) gates which query offsets persist K/V — the
+    speculative verify pass drops sampled rows' draft-position writes so
+    those rows need no slack pages; None = every offset writes."""
     if cfg.n_experts:
         w_qkv, w_out, router, w_up, w_down, ln_attn, ln_mlp = layer_params
     else:
@@ -537,11 +556,13 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
         # before the gather, and the mask is on absolute positions).
         new_pool_k, new_pool_v = pool_k_l, pool_v_l
         for i in range(q_len):
+            w_active = (active if write_mask is None
+                        else active & write_mask[:, i])
             new_pool_k = _scatter_token(
-                new_pool_k, tables, lengths + i, k[:, i], active
+                new_pool_k, tables, lengths + i, k[:, i], w_active
             )
             new_pool_v = _scatter_token(
-                new_pool_v, tables, lengths + i, v[:, i], active
+                new_pool_v, tables, lengths + i, v[:, i], w_active
             )
     else:
         # Prefill: scatter q_len rows of one slot at their ABSOLUTE
@@ -584,12 +605,12 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
 
 
 def _run_paged(cfg, params, state, x, q_positions, slot=None,
-               all_positions: bool = False):
+               all_positions: bool = False, write_mask=None):
     def body(carry, xs):
         layer_params, pool_k_l, pool_v_l = xs
         out, pool_k_l, pool_v_l = _paged_attend_layer(
             cfg, state, carry, layer_params, (pool_k_l, pool_v_l),
-            q_positions, slot,
+            q_positions, slot, write_mask,
         )
         return out, (pool_k_l, pool_v_l)
 
@@ -666,10 +687,14 @@ def _spec_verify_core(params: dict, state: PagedState, tokens,
 
     ``spec_mask`` [B] bool: rows whose drafts may accept. A sampled row
     rides the same pass with acceptance forced to 0 — it advances by
-    exactly its pending token (position ``length``), its draft slots'
-    junk K/V landing at length+1..length+K, provably overwritten before
-    any read: the row's next pass writes length+1..length+1+K, and the
-    causal mask hides junk beyond the query positions meanwhile.
+    exactly its pending token (position ``length``), and its draft
+    offsets' K/V scatters are DROPPED (``write_mask``): a row that can
+    never accept a draft must not consume pages past its real length,
+    so sampled requests reserve no speculative slack
+    (models/serving.py ``_pages_needed``). Its draft-position *scores*
+    read whatever stale data sits past ``length`` in the pool — finite
+    garbage whose outputs (y[:, 1:]) are discarded for that row, since
+    acceptance is 0 and only the pending position's logits are used.
 
     Returns ``(emitted [B, K+1], accepted [B], logits0 [B, V], state)``:
     row b's first ``accepted[b]`` emitted entries are its accepted
@@ -688,8 +713,13 @@ def _spec_verify_core(params: dict, state: PagedState, tokens,
     masked = dataclasses.replace(
         state, lengths=jnp.where(active, state.lengths, 0)
     )
+    # Offset 0 (the pending token) always writes; draft offsets write
+    # only for rows that can accept them.
+    write_mask = (spec_mask[:, None]
+                  | (jnp.arange(1 + k_len) == 0)[None, :])
     logits, new_k, new_v = _run_paged(
-        cfg, params, masked, x, q_positions, all_positions=True
+        cfg, params, masked, x, q_positions, all_positions=True,
+        write_mask=write_mask,
     )  # [B, 1+K, V]
     y = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, 1+K]
     draft = tokens[:, 1:]

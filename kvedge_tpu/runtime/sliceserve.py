@@ -131,6 +131,28 @@ class SlicePagedKVCache(PagedKVCache):
             max_pages_per_seq=max_pages_per_seq,
         )
 
+    # ---- refused host I/O ------------------------------------------------
+
+    def snapshot_pages(self, ids):
+        """Prefix-cache persistence is single-host only: the inherited
+        implementation would run a leader-only computation on a global
+        array — a collective the followers never join (wedge or crash).
+        The refusal lives here, with the API, not just at the workload
+        call-site guard."""
+        raise PagedCacheError(
+            "prefix-cache persistence is not supported on a slice cache"
+        )
+
+    def read_pages(self, ids):
+        raise PagedCacheError(
+            "prefix-cache persistence is not supported on a slice cache"
+        )
+
+    def write_pages(self, ids, k_vals, v_vals):
+        raise PagedCacheError(
+            "prefix-cache persistence is not supported on a slice cache"
+        )
+
     # ---- global-array plumbing ------------------------------------------
 
     def _init_state(self, shape, dtype) -> PagedState:

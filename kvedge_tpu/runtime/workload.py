@@ -869,6 +869,16 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
     ), serve_fn
 
 
+def _spec_draft_len(cfg) -> int:
+    """The draft length ``serving_speculative`` resolves to BEFORE the
+    boot probe: "auto" sizes pools for draft 4 (the probe may still
+    turn speculation off at boot — sizing for it keeps the pool
+    derivation independent of the probe's outcome)."""
+    if cfg.serving_speculative == "auto":
+        return 4
+    return cfg.serving_speculative
+
+
 def _serving_pool_dims(cfg, tcfg) -> tuple[int, int, int, int]:
     """``(slots, pages, page_size, max_pages_per_seq)`` of the paged
     pool — ONE derivation for the single-host server and the slice
@@ -876,9 +886,9 @@ def _serving_pool_dims(cfg, tcfg) -> tuple[int, int, int, int]:
     auto-sizes so every slot can hold a worst-case request — admission
     then only ever waits on slots, never on pages. Speculative mode
     widens both by the draft slack (a verify pass writes K positions
-    past the budget even when nothing accepts)."""
+    past a GREEDY request's budget even when nothing accepts)."""
     slots, page_size = cfg.serving_slots, cfg.serving_page_size
-    mpps = -(-(tcfg.max_seq + cfg.serving_speculative) // page_size)
+    mpps = -(-(tcfg.max_seq + _spec_draft_len(cfg)) // page_size)
     pages = cfg.serving_pages or slots * mpps
     return slots, pages, page_size, mpps
 
@@ -1184,14 +1194,43 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
             # the cache's pages can never drift apart; an injected
             # cache carries its own pool from the SAME derivation.
             slots, pages, page_size, _ = _serving_pool_dims(cfg, tcfg)
+            spec_draft = _spec_draft_len(cfg)
             paged_server = PagedGenerationServer(
                 params, tcfg, slots=slots, pages=pages,
                 page_size=page_size,
                 prefill_chunk=cfg.serving_prefill_chunk,
                 prefix_cache=cfg.serving_prefix_cache,
-                speculative=cfg.serving_speculative,
+                speculative=spec_draft,
+                window=cfg.serving_window,
                 cache=cache,
             )
+            # Spec-mode economics probe (VERDICT r4 #7): measure this
+            # session's verify-pass and window costs before traffic;
+            # "auto" falls back to windowed decode when windows
+            # dominate speculation's BEST case, an explicit K keeps
+            # the choice but warns loudly. Single-host only — the
+            # probe's device ops would broadcast into the slice
+            # op-stream before followers expect traffic shapes.
+            if spec_draft > 0 and cache is None:
+                decision = paged_server.resolve_speculation(
+                    auto=cfg.serving_speculative == "auto"
+                )
+                print(f"[kvedge-serve] speculative mode: "
+                      f"{decision['mode']} (best-case "
+                      f"{decision['spec_best_tokens_per_sec']}/s vs "
+                      f"windowed {decision['windowed_tokens_per_sec']}"
+                      f"/s per slot)", flush=True)
+            elif (spec_draft > 0 and cache is not None
+                    and cfg.serving_speculative == "auto"):
+                # "auto" promises measured economics; unmeasured
+                # speculation on a degraded relay is the regression
+                # the mode exists to prevent. Explicit K still runs
+                # speculation on a slice.
+                decision = paged_server.disable_speculation(
+                    "auto unmeasured on a slice"
+                )
+                print(f"[kvedge-serve] speculative mode: "
+                      f"{decision['mode']}", flush=True)
             # Prefix persistence (single-host only: the slice cache's
             # pool is a global array the leader cannot dump alone):
             # warm prefixes from the previous pod generation re-pin at
@@ -1212,6 +1251,13 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 if n:
                     print(f"[kvedge-serve] re-pinned {n} prefix-cache "
                           f"entries from {prefix_path}", flush=True)
+                # Periodic dumps (VERDICT r4 #10): a SIGKILL'd pod —
+                # the reference's own failure story — keeps its warm
+                # prefixes, not just a gracefully drained one. The
+                # close-time dump below stays as the freshest copy.
+                paged_server.start_prefix_persistence(
+                    prefix_path, fp, interval=30.0
+                )
             # One shared pool for row priming AND stream pumping, sized
             # 2x slots (only `slots` rows decode concurrently; one
             # primer + one pump each is the useful parallelism). Excess

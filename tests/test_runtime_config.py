@@ -170,3 +170,23 @@ def test_wrongly_typed_values_raise_config_error():
         RuntimeConfig.parse('[status]\nport = "abc"\n')
     with pytest.raises(RuntimeConfigError):
         RuntimeConfig.parse('[runtime]\nheartbeat_interval_s = "fast"\n')
+
+
+def test_serving_window_and_auto_speculative_round_trip():
+    cfg = RuntimeConfig.parse(
+        "[payload]\nserving = 'paged'\nserving_window = 128\n"
+        "serving_speculative = 'auto'\n"
+    )
+    assert cfg.serving_window == 128
+    assert cfg.serving_speculative == "auto"
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    # Explicit int still parses and round-trips.
+    cfg = RuntimeConfig.parse("[payload]\nserving_speculative = 6\n")
+    assert cfg.serving_speculative == 6
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    assert RuntimeConfig.parse("").serving_window == 64
+    for bad in ("serving_window = 0", "serving_window = 2048",
+                "serving_speculative = 'always'",
+                "serving_speculative = -1"):
+        with pytest.raises(RuntimeConfigError):
+            RuntimeConfig.parse(f"[payload]\n{bad}\n")

@@ -240,3 +240,22 @@ def test_slice_server_prefix_sharing_stays_exact(params, mesh):
         assert server.stats()["prefix_hits"] >= 1
     finally:
         server.close()
+
+
+def test_slice_cache_refuses_prefix_persistence(params, mesh):
+    """Prefix-cache dump/load would run leader-only computations on
+    global arrays — a collective the followers never join. The refusal
+    lives with the API (read_pages/write_pages raise), not just at the
+    workload call-site guard."""
+    import pytest
+
+    from kvedge_tpu.models.kvcache import PagedCacheError
+    from kvedge_tpu.runtime.sliceserve import SlicePagedKVCache
+
+    cache = SlicePagedKVCache(
+        CFG, slots=2, pages=16, page_size=4, mesh=mesh
+    )
+    with pytest.raises(PagedCacheError, match="single-host|not supported"):
+        cache.read_pages([0])
+    with pytest.raises(PagedCacheError, match="single-host|not supported"):
+        cache.write_pages([0], None, None)
