@@ -242,6 +242,36 @@ def measure_relay_rtt(samples: int = 20) -> float:
     return (time.perf_counter() - start) / samples * 1000.0
 
 
+def _floored_window(window: int, remaining: int) -> int:
+    """The serving loop's window discipline (serving._window_steps):
+    bounded by what remains, floored to a power of two — ONE definition
+    shared by every windowed bench leg so the benched plan is exactly
+    the server's."""
+    w = min(window, remaining)
+    return 1 << (w.bit_length() - 1) if w > 1 else w
+
+
+def _prefill_slots(cache, params, prompts):
+    """Admit + prefill every slot, returning the pending tokens [slots]
+    with a hard sync so prefill work stays out of the timed region."""
+    slots, prompt_len = prompts.shape
+    last = []
+    for s in range(slots):
+        cache.admit(s, prompt_len)
+        last.append(cache.prefill(params, s, prompts[s]))
+    tokens = jnp.argmax(jnp.stack(last), axis=-1).astype(jnp.int32)
+    float(tokens.sum())
+    return tokens
+
+
+def _best_time(run, cache, warmups: int = 3, reps: int = 3) -> float:
+    """Warm (compile + the relay's slow first execution + settle), then
+    best-of-``reps`` — the paged benches' shared harness."""
+    for _ in range(warmups):
+        run(cache)
+    return min(run(cache) for _ in range(reps))
+
+
 def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
                          page_size: int, window: int = PAGED_WINDOW):
     """Continuous-batching decode: (tokens/s, steps/s, hostloop steps/s).
@@ -267,30 +297,17 @@ def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
         dtype=jnp.int32,
     )
 
-    def prefill(cache):
-        last_logits = []
-        for s in range(slots):
-            cache.admit(s, prompt_len)
-            last_logits.append(cache.prefill(params, s, prompts[s]))
-        tokens = jnp.argmax(jnp.stack(last_logits), axis=-1).astype(
-            jnp.int32
-        )
-        float(tokens.sum())  # sync: prefill work stays out of the window
-        return tokens
-
     def run_windowed(cache) -> float:
         """The production greedy path: multi-page device windows
         (power-of-two floored at the remaining budget, exactly the
         server's _window_steps discipline), one host transfer of the
         window's tokens per dispatch — what the serving loop consumes
         to emit tokens and check budgets."""
-        tokens = prefill(cache)
+        tokens = _prefill_slots(cache, params, prompts)
         start = time.perf_counter()
         remaining = n_new
         while remaining:
-            w = min(window, remaining)
-            if w > 1:
-                w = 1 << (w.bit_length() - 1)
+            w = _floored_window(window, remaining)
             produced = cache.step_window(params, tokens, w)
             np.asarray(produced)  # the serving loop emits these
             tokens = produced[w - 1]
@@ -302,12 +319,13 @@ def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
 
     def run_hostloop(cache) -> float:
         """Per-step dispatch WITH the per-step host read the serving
-        loop performs (the sampled-slot path; r3's only path). An
-        async-pipelined loop that never fetches tokens would look much
-        faster here in low-latency relay sessions — and would not be
-        the loop the server can run, because it needs every token on
-        the host to emit and to check budgets."""
-        tokens = prefill(cache)
+        loop performs (the r3-era sampled-slot path, kept as the
+        baseline the window is measured against). An async-pipelined
+        loop that never fetches tokens would look much faster here in
+        low-latency relay sessions — and would not be the loop the
+        server can run, because it needs every token on the host to
+        emit and to check budgets."""
+        tokens = _prefill_slots(cache, params, prompts)
         start = time.perf_counter()
         for _ in range(n_new):
             logits = cache.step(params, tokens)
@@ -321,23 +339,70 @@ def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
     cache = PagedKVCache(
         cfg, slots=slots, pages=pages, page_size=page_size
     )
-    # Three warmup runs per path: compile (prefill + step + window
-    # programs), absorb the relay's slow first execution, settle the
-    # dispatch path. The host-looped path is the most relay-latency-
-    # exposed number in the bench (one dispatch per step), so it warms
-    # longer and takes best-of-3 where measure()'s scanned train step
-    # takes 2 (measure_decode is also best-of-3 for its own jitter).
-    for _ in range(3):
-        run_windowed(cache)
-    best = min(run_windowed(cache) for _ in range(3))
-    for _ in range(3):
-        run_hostloop(cache)
-    best_host = min(run_hostloop(cache) for _ in range(3))
+    best = _best_time(run_windowed, cache)
+    best_host = _best_time(run_hostloop, cache)
     return slots * n_new / best, n_new / best, n_new / best_host
 
 
+def measure_paged_mixed(cfg, slots: int, prompt_len: int, n_new: int,
+                        page_size: int, window: int = PAGED_WINDOW):
+    """Windowed decode with ONE sampled co-tenant in the batch
+    (tokens/s): the round-5 on-device sampling path
+    (kvcache.step_window_sampled). Before it, a single sampled request
+    forced the whole batch onto per-step dispatch — the
+    ``paged_decode_hostloop_steps_per_sec`` regime; now the mixed batch
+    rides the same window cadence as all-greedy, so this number should
+    sit near ``paged_decode_tokens_per_sec`` instead of collapsing to
+    the host-loop rate."""
+    from kvedge_tpu.models.kvcache import PagedKVCache
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pages = slots * -(-(prompt_len + n_new) // page_size)
+    prompts = jax.random.randint(
+        jax.random.PRNGKey(2), (slots, prompt_len), 0, cfg.vocab,
+        dtype=jnp.int32,
+    )
+    from kvedge_tpu.models.serving import _raw_key_data
+
+    seed = jax.random.fold_in(jax.random.PRNGKey(11), 0)
+    raw = _raw_key_data(seed)
+    key_data = np.zeros((slots,) + raw.shape, np.uint32)
+    key_data[0] = raw  # slot 0 samples; the rest decode greedy
+    smask = np.zeros((slots,), bool)
+    smask[0] = True
+    temps = np.ones((slots,), np.float32)
+    temps[0] = 0.8
+    top_ps = np.ones((slots,), np.float32)
+    top_ps[0] = 0.9
+
+    def run(cache) -> float:
+        tokens = np.asarray(_prefill_slots(cache, params, prompts))
+        start = time.perf_counter()
+        done = 0
+        while done < n_new:
+            w = _floored_window(window, n_new - done)
+            base = np.full((slots,), done + 1, np.int32)
+            produced = cache.step_window_sampled(
+                params, tokens, w, None, key_data, base, temps,
+                top_ps, smask,
+            )
+            produced = np.asarray(produced)
+            tokens = produced[w - 1]
+            done += w
+        elapsed = time.perf_counter() - start
+        for s in range(slots):
+            cache.release(s)
+        return elapsed
+
+    cache = PagedKVCache(
+        cfg, slots=slots, pages=pages, page_size=page_size
+    )
+    return slots * n_new / _best_time(run, cache)
+
+
 def measure_paged_spec(cfg, slots: int, prompt_len: int, n_new: int,
-                       page_size: int, draft_len: int):
+                       page_size: int, draft_len: int,
+                       adversarial: bool = False):
     """Batched speculative decoding through the paged cache (round 4's
     serving_speculative mode): (tokens/s, emitted_per_pass).
 
@@ -347,7 +412,13 @@ def measure_paged_spec(cfg, slots: int, prompt_len: int, n_new: int,
     schedule runs: host drafts per slot, ONE (1+draft_len)-query verify
     pass for the batch per dispatch, up to draft_len+1 tokens per slot
     per pass. One dispatch + one host read per pass — the same
-    RTT-per-pass profile as the windowed path at window≈emitted."""
+    RTT-per-pass profile as the windowed path at window≈emitted.
+
+    ``adversarial=True`` (VERDICT r4 #8) feeds RANDOM prompts instead —
+    prompt-lookup's worst case, acceptance ≈ 0 — so the committed
+    evidence brackets both ends: the favorable number is the mode's
+    headroom, the adversarial one is the pure verify-pass overhead a
+    mixed-traffic operator pays when drafts never land."""
     import types
 
     from kvedge_tpu.models.kvcache import PagedKVCache
@@ -355,10 +426,17 @@ def measure_paged_spec(cfg, slots: int, prompt_len: int, n_new: int,
 
     params = init_params(jax.random.PRNGKey(0), cfg)
     mpps = -(-(prompt_len + n_new + draft_len) // page_size)
-    pattern = jax.random.randint(
-        jax.random.PRNGKey(3), (1, 16), 0, cfg.vocab, dtype=jnp.int32
-    )
-    prompt = jnp.tile(pattern, (1, prompt_len // 16))[0]
+    if adversarial:
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(5), (prompt_len,), 0, cfg.vocab,
+            dtype=jnp.int32,
+        )
+    else:
+        pattern = jax.random.randint(
+            jax.random.PRNGKey(3), (1, 16), 0, cfg.vocab,
+            dtype=jnp.int32,
+        )
+        prompt = jnp.tile(pattern, (1, prompt_len // 16))[0]
 
     def run(cache) -> tuple[float, float]:
         reqs = []
@@ -564,9 +642,16 @@ def main() -> int:
     spec_tps, plain_b1_tps, spec_accept = measure_speculative(
         gqa, DECODE_PROMPT, DECODE_NEW
     )
+    paged_mixed_tps = measure_paged_mixed(
+        gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE
+    )
     paged_spec_tps, paged_spec_epp = measure_paged_spec(
         gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE,
         SPEC_DRAFT_LEN,
+    )
+    paged_spec_worst_tps, paged_spec_worst_epp = measure_paged_spec(
+        gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE,
+        SPEC_DRAFT_LEN, adversarial=True,
     )
     # Where speculation PAYS (VERDICT r3 #3): at the flagship scale the
     # per-verify fixed cost eats the acceptance (~1.05x above); the
@@ -615,6 +700,21 @@ def main() -> int:
                 # covariate to read it against.
                 "paged_spec_tokens_per_sec": round(paged_spec_tps, 1),
                 "paged_spec_emitted_per_pass": round(paged_spec_epp, 2),
+                # Worst case (random prompts, acceptance ≈ 0): the pure
+                # verify-pass overhead — brackets the favorable number
+                # above (VERDICT r4 #8).
+                "paged_spec_worstcase_tokens_per_sec": round(
+                    paged_spec_worst_tps, 1
+                ),
+                "paged_spec_worstcase_emitted_per_pass": round(
+                    paged_spec_worst_epp, 2
+                ),
+                # One sampled co-tenant in the windowed batch (round-5
+                # on-device sampling): should sit near
+                # paged_decode_tokens_per_sec, not collapse to the
+                # host-loop rate as it did when sampling forced
+                # per-step dispatch.
+                "paged_mixed_tokens_per_sec": round(paged_mixed_tps, 1),
                 # Session covariate: per-step-sync loops are RTT-bound;
                 # the windowed path amortizes RTT ~page_size x. Observed
                 # RTT ranges ~1.5-108 ms across sessions.

@@ -435,6 +435,45 @@ class PagedKVCache:
         )
         return toks
 
+    def step_window_sampled(self, params, tokens, n_steps: int, active,
+                            key_data, base_steps, temps, top_ps,
+                            sampled_mask):
+        """``n_steps`` mixed greedy/sampled decode steps in ONE
+        dispatched program (see :func:`_paged_decode_window_sampled_impl`
+        for the key-schedule argument). Same growth/length discipline
+        as :meth:`step_window`; all per-row sampling inputs are host
+        arrays ([B]-shaped; ``key_data`` [B, 2] uint32)."""
+        slots = self._step_slots(active)
+        grew = False
+        for slot in slots:
+            grew |= self.grow_to(slot, n_steps)
+        if grew:
+            self._sync()
+        toks = self._device_window_sampled(
+            params, tokens, n_steps, active, key_data, base_steps,
+            temps, top_ps, sampled_mask,
+        )
+        for slot in slots:
+            self._host_lengths[slot] += n_steps
+        return toks
+
+    def _device_window_sampled(self, params, tokens, n_steps: int,
+                               active, key_data, base_steps, temps,
+                               top_ps, sampled_mask):
+        """Device seam: mixed window (overridden by the slice cache)."""
+        import numpy as _np
+
+        toks, self.state = _paged_decode_window_sampled(
+            params, self.state, jnp.asarray(tokens, jnp.int32),
+            self.cfg, n_steps, self._active_array(self.state, active),
+            jnp.asarray(_np.asarray(key_data, _np.uint32)),
+            jnp.asarray(_np.asarray(base_steps, _np.int32)),
+            jnp.asarray(_np.asarray(temps, _np.float32)),
+            jnp.asarray(_np.asarray(top_ps, _np.float32)),
+            jnp.asarray(_np.asarray(sampled_mask, bool)),
+        )
+        return toks
+
     def step_spec(self, params, tokens, active, spec_mask):
         """One speculative verify pass (see :func:`_spec_verify_core`).
 
@@ -771,3 +810,52 @@ def _paged_decode_window_impl(params: dict, state: PagedState, tokens,
 _paged_decode_window = functools.partial(
     jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(1,)
 )(_paged_decode_window_impl)
+
+
+def _paged_decode_window_sampled_impl(params: dict, state: PagedState,
+                                      tokens, cfg: TransformerConfig,
+                                      n_steps: int, active, key_data,
+                                      base_steps, temps, top_ps,
+                                      sampled_mask):
+    """``n_steps`` decode steps with mixed greedy/sampled feedback.
+
+    The round-5 fix for the sampled-RTT tax (VERDICT r4 #3): the
+    per-token sampling key is ``fold_in(row_seed, t)`` with ``t`` a
+    pure function of the request's emitted count — host-known at
+    dispatch — so the whole key schedule rides the scan carry as
+    ``base_steps + i``. Each step applies the SAME nucleus filter and
+    categorical draw as the host path (decode.sample_token), then
+    selects sampled vs greedy per row by ``sampled_mask``; one host
+    round trip serves a window of sampled tokens exactly as it does
+    greedy ones, and one sampled co-tenant no longer drags the whole
+    batch onto per-step dispatch.
+
+    ``key_data`` is raw uint32 key data ([B, 2] for threefry), wrapped
+    on device — raw data crosses process boundaries (the slice
+    op-stream) where typed key arrays cannot.
+    """
+    keys = jax.random.wrap_key_data(key_data)
+
+    def body(carry, i):
+        state, toks = carry
+        logits, state = _decode_step_core(params, state, toks, cfg,
+                                          active)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        from kvedge_tpu.models.decode import sample_token
+
+        step_keys = jax.vmap(jax.random.fold_in)(keys, base_steps + i)
+        sampled = sample_token(
+            logits, step_keys, temps[:, None], top_ps[:, None]
+        )
+        nxt = jnp.where(sampled_mask, sampled, greedy).astype(jnp.int32)
+        return (state, nxt), nxt
+
+    (state, _), produced = jax.lax.scan(
+        body, (state, tokens), jnp.arange(n_steps)
+    )
+    return produced, state
+
+
+_paged_decode_window_sampled = functools.partial(
+    jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(1,)
+)(_paged_decode_window_sampled_impl)

@@ -35,6 +35,30 @@ import numpy as np
 _STREAM_DONE = object()
 
 
+def _raw_key_data(key) -> np.ndarray:
+    """Raw uint32 key data from a PRNG key, typed or legacy — the form
+    that crosses host/process boundaries (the sampled-window dispatch
+    and the slice op-stream); kvcache wraps it back on device with the
+    DEFAULT impl, so a typed key built with any other PRNG impl is
+    rejected here, per-request at submit — not deep in the decode loop
+    where the failure would poison every co-tenant."""
+    import jax
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(key)
+    if jnp.issubdtype(arr.dtype, jax.dtypes.prng_key):
+        default = str(jax.random.key_impl(jax.random.key(0)))
+        got = str(jax.random.key_impl(arr))
+        if got != default:
+            raise ValueError(
+                f"sampling seed key uses PRNG impl {got}; the serving "
+                f"key schedule is defined on the default impl "
+                f"({default}) — pass a jax.random.PRNGKey/key() seed"
+            )
+        return np.asarray(jax.random.key_data(arr))
+    return np.asarray(arr, np.uint32)
+
+
 class ServerBusy(RuntimeError):
     """No slot/page capacity became available within the timeout."""
 
@@ -61,6 +85,11 @@ class _Request:
     # auto guard rail can zero _spec; recomputing at release would then
     # under-release a greedy request's slack).
     pages_reserved: int = 0
+    # Raw uint32 data of the sampling seed key, fetched ONCE at
+    # admission (the sampled-window dispatch needs it host-side every
+    # window; re-fetching from the device key per window would add a
+    # transfer per request per window).
+    key_data: "np.ndarray | None" = None
     generated: list[int] = dataclasses.field(default_factory=list)
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event
@@ -329,6 +358,7 @@ class PagedGenerationServer:
         req = _Request(
             prompt=list(prompt), n_new=n_new, sampling=sampling,
             pages_reserved=pages_needed,
+            key_data=_raw_key_data(sampling[0]) if sampling else None,
             stream=queue.SimpleQueue() if stream else None,
         )
         deadline = time.monotonic() + timeout
@@ -1024,17 +1054,50 @@ class PagedGenerationServer:
         stays small ({2, 4, ..., window}). Multi-page windows are legal:
         ``grow_to`` allocates every page the window's scatters need up
         front, inside the request's admission-time reservation. Sampled
-        requests force the per-step path: their key schedule folds a
-        host-side step index per token.
+        requests ride windows too (round 5): their per-token keys are
+        ``fold_in(seed, base + i)`` with ``base`` host-known at
+        dispatch, so the schedule lives in the scan carry
+        (kvcache.step_window_sampled).
         """
-        if any(req.sampling is not None for req in self._active.values()):
-            return 1
         w = min(req.n_new - len(req.generated) - 1
                 for req in self._active.values())
         w = min(w, self._window)
         if w <= 1:
             return 1
         return 1 << (w.bit_length() - 1)
+
+    def _sampled_window(self, tokens, window: int, mask, samplers):
+        """Dispatch one mixed greedy/sampled device window (lock held).
+
+        Builds the per-row sampling inputs: row seeds (raw key data),
+        base token indices (``len(generated) + 1`` — the same schedule
+        the per-step host path folds, so windowed and per-step sampled
+        tokens are identical), temperature/top-p, and the sampled-row
+        mask. Greedy rows get neutral values (temp 1, top_p 1, zero
+        key) that the kernel's per-row select never reads."""
+        n = self._cache.slots
+        key_data = np.zeros((n,) + self._key_data_shape(samplers),
+                            np.uint32)
+        base_steps = np.zeros((n,), np.int32)
+        temps = np.ones((n,), np.float32)
+        top_ps = np.ones((n,), np.float32)
+        smask = np.zeros((n,), bool)
+        for slot, req in samplers.items():
+            key_data[slot] = req.key_data
+            base_steps[slot] = len(req.generated) + 1
+            temps[slot] = float(req.sampling[1])
+            top_ps[slot] = float(req.sampling[2])
+            smask[slot] = True
+        return self._cache.step_window_sampled(
+            self._params, tokens, window, mask, key_data, base_steps,
+            temps, top_ps, smask,
+        )
+
+    @staticmethod
+    def _key_data_shape(samplers) -> tuple:
+        """Trailing shape of one row's raw key data (threefry: (2,));
+        taken from a live request so the impl is never hardcoded."""
+        return next(iter(samplers.values())).key_data.shape
 
     def _next_tokens(self, logits) -> dict[int, int]:
         """Every active slot's next token from the step's [slots, V]
@@ -1187,16 +1250,31 @@ class PagedGenerationServer:
                     mask[slot] = True
                 window = self._window_steps()
                 if window > 1:
-                    # Device-side window: `window` greedy steps in
-                    # one dispatched scan (kvcache.step_window) —
-                    # the host pays one round trip per window, not
-                    # per token. Admission re-syncs between windows
-                    # (a submitter blocks on this lock until the
-                    # window returns, then joins the next one).
-                    produced = np.asarray(self._cache.step_window(
-                        self._params, jnp.asarray(tokens), window,
-                        active=mask,
-                    ))
+                    # Device-side window: `window` steps in one
+                    # dispatched scan — the host pays one round trip
+                    # per window, not per token. Admission re-syncs
+                    # between windows (a submitter blocks on this lock
+                    # until the window returns, then joins the next
+                    # one). Greedy-only batches run the plain argmax
+                    # scan; a batch with sampled rows runs the mixed
+                    # kernel, whose on-device key schedule emits the
+                    # SAME tokens as the per-step path (pinned by
+                    # tests) — one sampled co-tenant no longer drags
+                    # the batch onto per-step dispatch.
+                    samplers = {
+                        slot: req
+                        for slot, req in self._active.items()
+                        if req.sampling is not None
+                    }
+                    if not samplers:
+                        produced = np.asarray(self._cache.step_window(
+                            self._params, jnp.asarray(tokens), window,
+                            active=mask,
+                        ))
+                    else:
+                        produced = np.asarray(self._sampled_window(
+                            tokens, window, mask, samplers
+                        ))
                     for slot, req in self._active.items():
                         self._emit(req, req.next_token)
                         for i in range(window - 1):

@@ -48,12 +48,14 @@ from kvedge_tpu.models.kvcache import (
     PagedState,
     _decode_step_core,
     _paged_decode_window_impl,
+    _paged_decode_window_sampled_impl,
     _paged_prefill_impl,
     _spec_verify_core,
 )
 
 # Op codes (header[0]). STOP ends the follower loop.
-OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW, OP_SPEC = range(6)
+(OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW, OP_SPEC,
+ OP_WSAMPLE) = range(7)
 _HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
 
 
@@ -97,7 +99,12 @@ def _slice_kernels(mesh, cfg):
         _spec_verify_core, static_argnames=("cfg",),
         donate_argnums=(1,), out_shardings=(rep, rep, rep, state_sh),
     )
-    return rep, state_sh, prefill, step, window, spec
+    wsample = jax.jit(
+        _paged_decode_window_sampled_impl,
+        static_argnames=("cfg", "n_steps"), donate_argnums=(1,),
+        out_shardings=(rep, state_sh),
+    )
+    return rep, state_sh, prefill, step, window, spec, wsample
 
 
 class SlicePagedKVCache(PagedKVCache):
@@ -123,7 +130,8 @@ class SlicePagedKVCache(PagedKVCache):
 
         self.mesh = mesh
         (self._rep, self._state_sh, self._k_prefill, self._k_step,
-         self._k_window, self._k_spec) = _slice_kernels(mesh, cfg)
+         self._k_window, self._k_spec,
+         self._k_wsample) = _slice_kernels(mesh, cfg)
         self._is_leader = jax.process_index() == 0
         self._stopped = False
         super().__init__(
@@ -278,6 +286,38 @@ class SlicePagedKVCache(PagedKVCache):
         )
         return self._read(toks)
 
+    def _device_window_sampled(self, params, tokens, n_steps: int,
+                               active, key_data, base_steps, temps,
+                               top_ps, sampled_mask):
+        self._check_live()
+        tokens = np.asarray(tokens, np.int32)
+        key_data = np.asarray(key_data, np.uint32)
+        self._send_header(OP_WSAMPLE, n_steps, key_data.shape[1])
+        payload = self._bcast((
+            tokens, self._active_np(active), key_data,
+            np.asarray(base_steps, np.int32),
+            np.asarray(temps, np.float32),
+            np.asarray(top_ps, np.float32),
+            np.asarray(sampled_mask, bool),
+        ))
+        return self._exec_window_sampled(
+            params, *(np.asarray(x) for x in payload), n_steps=n_steps
+        )
+
+    def _exec_window_sampled(self, params, tokens, mask, key_data,
+                             base_steps, temps, top_ps, smask, *,
+                             n_steps: int):
+        toks, self.state = self._k_wsample(
+            params, self.state, self._global(tokens.astype(np.int32)),
+            self.cfg, n_steps, self._global(mask.astype(bool)),
+            self._global(key_data.astype(np.uint32)),
+            self._global(base_steps.astype(np.int32)),
+            self._global(temps.astype(np.float32)),
+            self._global(top_ps.astype(np.float32)),
+            self._global(smask.astype(bool)),
+        )
+        return self._read(toks)
+
     def _device_spec(self, params, tokens, active, spec_mask):
         self._check_live()
         tokens = np.asarray(tokens, np.int32)
@@ -343,6 +383,22 @@ class SlicePagedKVCache(PagedKVCache):
             ))
             self._exec_window(params, np.asarray(tokens),
                               np.asarray(mask), a)
+        elif op == OP_WSAMPLE:
+            # a = n_steps, b = key-data width (impl-dependent: 2 for
+            # threefry) — the follower's zero templates must match the
+            # leader's broadcast shapes exactly.
+            payload = self._bcast((
+                np.zeros((self.slots,), np.int32),
+                np.zeros((self.slots,), bool),
+                np.zeros((self.slots, b), np.uint32),
+                np.zeros((self.slots,), np.int32),
+                np.zeros((self.slots,), np.float32),
+                np.zeros((self.slots,), np.float32),
+                np.zeros((self.slots,), bool),
+            ))
+            self._exec_window_sampled(
+                params, *(np.asarray(x) for x in payload), n_steps=a
+            )
         elif op == OP_SPEC:
             tokens, mask, smask = self._bcast((
                 np.zeros((self.slots, a + 1), np.int32),

@@ -59,3 +59,28 @@ def test_paged_decode_bench_runs_and_counts_tokens():
     )
     assert tps > 0 and sps > 0 and host_sps > 0
     assert abs(tps - 3 * sps) < 1e-6
+
+
+def test_paged_mixed_and_adversarial_spec_benches_run():
+    """The round-5 legs: the mixed greedy+sampled window bench and the
+    adversarial (random-prompt) spec bench both run on the CPU backend
+    and report positive throughput; adversarial acceptance collapses
+    toward 1 emitted/pass (drafts never land on random text)."""
+    import dataclasses as dc
+
+    from bench import measure_paged_mixed, measure_paged_spec
+
+    small = dc.replace(
+        FLAGSHIP, d_model=64, n_layers=2, d_ff=128, vocab=256,
+        max_seq=64, n_heads=4, n_kv_heads=2,
+    )
+    tps = measure_paged_mixed(
+        small, slots=3, prompt_len=8, n_new=10, page_size=4, window=8
+    )
+    assert tps > 0
+    worst_tps, worst_epp = measure_paged_spec(
+        small, slots=2, prompt_len=16, n_new=8, page_size=4,
+        draft_len=4, adversarial=True,
+    )
+    assert worst_tps > 0
+    assert worst_epp <= 2.0  # acceptance ~0: ~1 token per pass

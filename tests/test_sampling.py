@@ -150,3 +150,62 @@ def test_serve_endpoint_paged_and_contiguous_sampling_agree(tmp_path):
     finally:
         paged_fn.close()
         contiguous_fn.close()
+
+
+def test_sampled_windows_match_per_step_and_contiguous(params):
+    """Round-5 on-device sampling: sampled requests decoded through
+    multi-step device windows (kvcache.step_window_sampled) emit
+    exactly the tokens of (a) the per-step host-sampling path
+    (window=1) and (b) the contiguous scan backend — the key schedule
+    fold_in(seed, base + i) rides the scan carry bit-exactly."""
+    import threading
+
+    prompt, n_new = [5, 9, 2, 7], 24
+    temperature, top_p, seed = 0.8, 0.9, 11
+    base = jax.random.PRNGKey(seed)
+    row_key = jax.random.fold_in(base, 0)
+    sampling = (row_key, jnp.float32(temperature), jnp.float32(top_p))
+
+    out = generate(
+        params, jnp.asarray([prompt], jnp.int32), CFG, n_new=n_new,
+        sampling=(row_key[None], jnp.float32(temperature),
+                  jnp.float32(top_p)),
+        sampled=True,
+    )
+    contiguous = [int(t) for t in np.asarray(out)[0]]
+
+    results = {}
+    for name, window in (("windowed", 16), ("per_step", 1)):
+        server = PagedGenerationServer(params, CFG, slots=2, pages=16,
+                                       page_size=4, window=window)
+        try:
+            results[name] = server.submit(prompt, n_new,
+                                          sampling=sampling)
+        finally:
+            server.close()
+    assert results["windowed"] == contiguous
+    assert results["per_step"] == contiguous
+
+    # Mixed batch: a greedy co-tenant rides the SAME mixed window and
+    # still equals its greedy contiguous decode; the sampled tokens
+    # are unchanged by the co-tenant (row independence).
+    server = PagedGenerationServer(params, CFG, slots=2, pages=24,
+                                   page_size=4, window=16)
+    try:
+        mixed = {}
+        t = threading.Thread(
+            target=lambda: mixed.update(
+                g=server.submit([3, 1, 4, 1, 5], 20)
+            )
+        )
+        t.start()
+        mixed["s"] = server.submit(prompt, n_new, sampling=sampling)
+        t.join(timeout=300)
+        greedy_want = generate(
+            params, jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32), CFG,
+            n_new=20,
+        )
+        assert mixed["s"] == contiguous
+        assert mixed["g"] == [int(x) for x in np.asarray(greedy_want)[0]]
+    finally:
+        server.close()

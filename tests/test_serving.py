@@ -1030,14 +1030,22 @@ def test_spec_slack_reserved_only_for_greedy(params):
         key = jax.random.fold_in(jax.random.PRNGKey(3), 0)
         sampling = (key, jnp.float32(0.8), jnp.float32(0.9))
         # Sampled: 4 prompt + 8 new = 12 tokens -> 3 pages, NO slack.
+        # (Asserted on the request's own stored reservation — the
+        # aggregate gauge races request completion.)
         hs = server.submit_stream([1, 2, 3, 4], n_new=8,
-                                   sampling=sampling)
-        assert server.stats()["reserved_pages"] == 3
-        # Greedy joins: 12 tokens + 4 slack -> 4 pages. Total 7.
+                                  sampling=sampling)
+        assert hs._req.pages_reserved == 3
+        # Greedy: 12 tokens + 4 slack -> 4 pages.
         hg = server.submit_stream([5, 6, 7, 8], n_new=8)
-        assert server.stats()["reserved_pages"] == 7
+        assert hg._req.pages_reserved == 4
         list(hs)
         list(hg)
+        # Both released their exact reservations: gauge returns to 0.
+        deadline = __import__("time").monotonic() + 30
+        while (server.stats()["reserved_pages"]
+               and __import__("time").monotonic() < deadline):
+            __import__("time").sleep(0.01)
+        assert server.stats()["reserved_pages"] == 0
     finally:
         server.close()
 
