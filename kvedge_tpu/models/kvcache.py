@@ -65,6 +65,38 @@ class PagedCacheError(RuntimeError):
     pass
 
 
+_PAGED_KERNEL_AUTO_MIN_SEQ = 2048
+_PAGED_KERNEL_AUTO_MIN_PAGE = 64
+
+
+def _use_paged_kernel(cfg: TransformerConfig, page_size: int,
+                      width: int) -> bool:
+    """Resolve ``cfg.paged_attention`` at trace time (page_size/width
+    are static pool-shape facts under jit). "auto" picks the Pallas
+    block-table kernel exactly where it MEASURED faster on v5e
+    (BENCH_r05 long-context leg): TPU, long-context caps
+    (max_seq >= 2048), pages >= 64 tokens (the kernel's per-page DMA
+    loop is latency-bound — at 16-token pages its 4 KB copies lose to
+    XLA's bulk gather, ~1.17x WIN flips to ~0.6x loss), and
+    kv_heads*d_head % 128 == 0 (TPU DMA lane alignment; MHA at one kv
+    head takes the gather). The gather also keeps the short-context
+    default because the kernel is numerically equivalent but not
+    BIT-identical (it skips the gather's bf16 weight rounding; logits
+    agree to ~1e-2, measured), so the default path stays bit-stable
+    where the paged == contiguous exactness pin runs. Either choice
+    can be forced with "kernel"/"gather"; cfg is a static jit argument,
+    so changing the choice retraces rather than silently reusing a
+    cached program."""
+    if cfg.paged_attention == "kernel":
+        return True
+    if cfg.paged_attention == "gather":
+        return False
+    return (jax.default_backend() == "tpu"
+            and cfg.max_seq >= _PAGED_KERNEL_AUTO_MIN_SEQ
+            and page_size >= _PAGED_KERNEL_AUTO_MIN_PAGE
+            and width % 128 == 0)
+
+
 class PagedKVCache:
     """Host-side pool manager wrapping a :class:`PagedState`.
 
@@ -616,20 +648,37 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
         new_pool_k = pool_k_l.at[page_idx, offset].set(k[0])
         new_pool_v = pool_v_l.at[page_idx, offset].set(v[0])
 
-    gk, gv = _gathered(
-        dataclasses.replace(state, tables=tables),
-        (new_pool_k, new_pool_v),
-    )
-    qg = q.reshape(batch, q_len, kv, group, dh)
-    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, gk) / (dh ** 0.5)
-    key_pos = jnp.arange(gk.shape[1])
-    allowed = key_pos[None, None, :] <= q_positions[:, :, None]  # [B, Q, S]
-    scores = jnp.where(
-        allowed[:, None, None], scores, jnp.finfo(dtype).min
-    )
-    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
-    attended = jnp.einsum("bkgqs,bskd->bqkgd", weights, gv)
-    x = x + attended.reshape(batch, q_len, h * dh) @ w_out.astype(dtype)
+    if (slot is None and q_len == 1
+            and _use_paged_kernel(cfg, pool_k_l.shape[1], kv * dh)):
+        # Single-query decode (steps and windows): attention directly
+        # over the block table — K/V pages stream up to each row's LIVE
+        # length through the Pallas kernel; the padded pool view is
+        # never materialized (ops/paged_attention.py).
+        from kvedge_tpu.ops.paged_attention import paged_decode_attention
+
+        att = paged_decode_attention(
+            q[:, 0], new_pool_k, new_pool_v, tables, q_positions[:, 0],
+            interpret=jax.default_backend() != "tpu",
+        )  # [B, H, Dh], kv-major head layout — same as the einsum's
+        x = x + att.reshape(batch, 1, h * dh) @ w_out.astype(dtype)
+    else:
+        gk, gv = _gathered(
+            dataclasses.replace(state, tables=tables),
+            (new_pool_k, new_pool_v),
+        )
+        qg = q.reshape(batch, q_len, kv, group, dh)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, gk) / (dh ** 0.5)
+        key_pos = jnp.arange(gk.shape[1])
+        allowed = (key_pos[None, None, :]
+                   <= q_positions[:, :, None])  # [B, Q, S]
+        scores = jnp.where(
+            allowed[:, None, None], scores, jnp.finfo(dtype).min
+        )
+        weights = jax.nn.softmax(
+            scores.astype(jnp.float32), axis=-1
+        ).astype(dtype)
+        attended = jnp.einsum("bkgqs,bskd->bqkgd", weights, gv)
+        x = x + attended.reshape(batch, q_len, h * dh) @ w_out.astype(dtype)
 
     normed = _rmsnorm(x, ln_mlp)
     if cfg.n_experts:

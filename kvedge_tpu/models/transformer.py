@@ -104,6 +104,18 @@ class TransformerConfig:
     # axis to forward(). Flash requires seq to be a multiple of its
     # block size.
     attention: str = "naive"
+    # Paged DECODE attention (models/kvcache.py single-query steps and
+    # windows): "gather" materializes the per-sequence pool view
+    # (pool[tables] — cost scales with the pool CAP); "kernel" streams
+    # K/V pages block-table-indexed through a Pallas kernel with an
+    # online softmax — per-step cost scales with each sequence's LIVE
+    # length (ops/paged_attention.py; numerically equivalent to the
+    # gather within bf16 rounding, not bit-identical). "auto" picks the
+    # kernel on TPU at long-context caps (max_seq >= 2048, where the
+    # cap-vs-live difference is the bill) and the gather elsewhere.
+    # Prefill and the speculative verify pass always use the gather
+    # path (multi-query shapes).
+    paged_attention: str = "auto"
 
     @property
     def d_head(self) -> int:
@@ -149,6 +161,11 @@ class TransformerConfig:
             raise ValueError(
                 "attention must be 'naive', 'flash', 'ring', or "
                 f"'ulysses', got {self.attention!r}"
+            )
+        if self.paged_attention not in ("auto", "kernel", "gather"):
+            raise ValueError(
+                "paged_attention must be 'auto', 'kernel', or "
+                f"'gather', got {self.paged_attention!r}"
             )
         if self.n_experts < 0:
             raise ValueError("n_experts must be >= 0 (0 = dense FFN)")

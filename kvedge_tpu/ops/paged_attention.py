@@ -1,0 +1,219 @@
+"""Paged-attention decode as a Pallas TPU kernel.
+
+Why: the paged decode step's einsum path materializes a per-sequence
+contiguous view of the ENTIRE padded pool — ``pool[tables]`` gathers
+``[B, max_pages x page, K, Dh]`` and attends over the padded maximum
+(kvedge_tpu/models/kvcache.py ``_gathered``), so per-step HBM traffic
+scales with the pool CAP, not the live content. At max_seq 1024 the
+difference is invisible; at the long contexts the flash kernel exists
+for (4k-8k+), a half-empty pool still pays full price every step —
+exactly where vLLM-class paged attention earns its keep (VERDICT r4
+missing #1).
+
+This kernel computes decode attention DIRECTLY over the block table:
+
+* grid = (batch,): ONE program per sequence, whose page loop is a
+  ``fori_loop`` bounded by that row's LIVE page count (read from the
+  scalar-prefetched lengths). Dead pages cost literally nothing — no
+  DMA, no grid step. (A first design used a (batch, max_pages)
+  BlockSpec grid with dead pages skipping work under ``pl.when``; its
+  ~0.5 us/program grid overhead made total cost track the CAP anyway —
+  measured flat ~1.7-3 ms across live lengths at an 8192 cap on v5e —
+  so the page loop moved inside the program.)
+* the pools stay in HBM (memory_space=ANY); each live page is fetched
+  by a manual double-buffered ``make_async_copy`` — page j+1's DMA
+  issues before page j's compute, so the loop runs at max(DMA, compute)
+  per page. Pages are [page, K*Dh] slices (kv heads merged into the
+  lane dim: TPU DMA needs a 128-aligned minor dim, which rules out
+  [page, K, 64]; shapes with K*Dh % 128 != 0 — e.g. MHA at one kv
+  head — use the gather path, enforced at call time).
+* one full-width dot scores every query head per page: q arrives
+  PLACED — q2[h] carries head h's query in its kv head's Dh-slot,
+  zeros elsewhere — so ``q2 @ page^T`` contracts over K*Dh and the
+  zero slots kill cross-head terms exactly (fp32 zeros add nothing).
+  The [H, width] accumulator's per-head slot is extracted outside.
+* online softmax (running max / denominator, fp32) carried through the
+  fori_loop — the same discipline as ops/attention.py.
+* numerics mirror the einsum path where rounding is visible: scores
+  are computed with fp32 accumulation, rounded to the compute dtype,
+  and scaled in that dtype before the fp32 softmax — the einsum path's
+  exact sequence — so kernel and gather logits differ only by softmax
+  accumulation order and weight rounding (~1e-2, measured; pinned by
+  tolerance + greedy-token equality in tests/test_paged_attention.py,
+  and by the bench's long-context leg's logits gate on the real chip
+  before it times anything).
+
+The serving stack selects this kernel per ``TransformerConfig
+.paged_attention`` ("auto" = kernel on TPU at long-context caps,
+einsum gather elsewhere); the verify pass (multi-query) and prefill
+keep the einsum path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_dma_kernel(tables_ref, pos_ref, q_ref, k_hbm, v_hbm, o_ref,
+                       kbuf, vbuf, sems, *, page: int, width: int,
+                       dh: int, dtype):
+    """One program per SEQUENCE: stream that row's live pages by manual
+    double-buffered DMA and fold them with an online softmax.
+
+    The BlockSpec-grid variant still pays one grid step per page of the
+    CAP — dead pages can skip their DMA and compute, but ~0.5 us of
+    per-program overhead each makes total cost track the cap anyway
+    (measured: flat ~1.7-3 ms across live lengths at an 8192 cap on
+    v5e). Here the grid is (batch,) and the page loop is a
+    ``fori_loop`` bounded by the row's LIVE page count read from the
+    scalar-prefetched lengths — dead pages cost literally nothing.
+
+    Layout: the pools arrive as [P, page, width] views (width = K*Dh,
+    the kv heads merged into the lane dim — TPU DMA slices need a
+    128-aligned minor dim, which [page, K, 64] is not). q arrives
+    PLACED: q2[h] carries head h's query in its kv head's Dh-slot and
+    zeros elsewhere, so ``q2 @ k_page^T`` contracts over width and the
+    zero slots kill cross-head terms exactly (fp32 zeros add nothing)
+    — same scores as the per-head dot, no interleaving mask. The
+    accumulator is [H, width]; the caller extracts each head's own
+    Dh-slot outside the kernel. kbuf/vbuf [2, page, width] double
+    buffers; sems [2, 2] one DMA semaphore per (slot, k|v).
+    """
+    b = pl.program_id(0)
+    q_pos = pos_ref[b]
+    n_pages = q_pos // page + 1
+
+    def dma(slot, j, hbm, buf, which):
+        return pltpu.make_async_copy(
+            hbm.at[tables_ref[b, j]], buf.at[slot],
+            sems.at[slot, which],
+        )
+
+    dma(0, 0, k_hbm, kbuf, 0).start()
+    dma(0, 0, v_hbm, vbuf, 1).start()
+
+    q2 = q_ref[0]  # [H, width], zero outside each head's own slot
+    h = q2.shape[0]
+    scale = jnp.asarray(dh ** 0.5, dtype)
+
+    def body(j, carry):
+        m_prev, l_prev, acc_prev = carry
+        slot = j % 2
+
+        @pl.when(j + 1 < n_pages)
+        def _():
+            dma((j + 1) % 2, j + 1, k_hbm, kbuf, 0).start()
+            dma((j + 1) % 2, j + 1, v_hbm, vbuf, 1).start()
+
+        # Wait on this slot's in-flight copies (same refs/semaphore as
+        # the start — the descriptor identifies the transfer).
+        dma(slot, j, k_hbm, kbuf, 0).wait()
+        dma(slot, j, v_hbm, vbuf, 1).wait()
+
+        kj = kbuf[slot]  # [page, width]
+        vj = vbuf[slot]
+        s32 = jax.lax.dot_general(
+            q2, kj,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [H, page] — exact per-head scores (zero slots add nothing)
+        s16 = s32.astype(dtype) / scale
+        key_pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, s16.shape, 1
+        )
+        s = jnp.where(
+            key_pos <= q_pos, s16, jnp.finfo(dtype).min
+        ).astype(jnp.float32)
+
+        m_new = jnp.maximum(
+            m_prev, jnp.max(s, axis=-1, keepdims=True)
+        )
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * correction + jax.lax.dot_general(
+            p.astype(vj.dtype), vj,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [H, width]; head h's slot extracted by the caller
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((h, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((h, 1), jnp.float32)
+    acc0 = jnp.zeros((h, q2.shape[1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, pool_k, pool_v, tables, q_positions,
+                           *, interpret: bool = False):
+    """Decode attention over a paged KV pool, block-table-indexed.
+
+    q [B, H, Dh] (post-rotary, ONE query token per sequence, kv-major
+    head layout: head h = kv_head * group + g — split_qkv's layout);
+    pool_k/pool_v [P, page, K, Dh]; tables [B, max_pages] int32;
+    q_positions [B] int32 (row b attends key positions 0..q_positions[b],
+    whose K/V — including the current token's — are already scattered).
+    Returns [B, H, Dh]. Cost scales with each row's LIVE page count.
+    """
+    batch, h, dh = q.shape
+    pages_total, page, kv, _ = pool_k.shape
+    _, max_pages = tables.shape
+    group = h // kv
+    width = kv * dh
+    if width % 128 and not interpret:
+        raise ValueError(
+            f"paged decode kernel needs kv_heads * d_head to be a "
+            f"multiple of 128 (TPU DMA lane alignment), got {kv} x {dh} "
+            f"= {width}; use paged_attention='gather' for this shape"
+        )
+
+    # kv heads merged into the lane dim: a [page, width] slice is a
+    # contiguous, 128-aligned DMA (the [page, K, 64] layout is not).
+    k_view = pool_k.reshape(pages_total, page, width)
+    v_view = pool_v.reshape(pages_total, page, width)
+    # Placed queries: head h = k'*group + g occupies columns
+    # [k'*Dh, (k'+1)*Dh), zeros elsewhere — the full-width dot then
+    # yields exactly the per-head scores (zero slots contribute nothing
+    # in fp32 accumulation).
+    head_slot = jnp.arange(h) // group                 # [H] kv index
+    col_slot = jnp.arange(width) // dh                 # [width]
+    place = (head_slot[:, None] == col_slot[None, :])  # [H, width]
+    q2 = jnp.where(place[None], jnp.tile(q, (1, 1, kv)), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch,),
+        in_specs=[
+            pl.BlockSpec((1, h, width), lambda b, t, p: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # pools stay in HBM;
+            pl.BlockSpec(memory_space=pl.ANY),  # the kernel DMAs pages
+        ],
+        out_specs=pl.BlockSpec((1, h, width), lambda b, t, p: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page, width), pool_k.dtype),
+            pltpu.VMEM((2, page, width), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_dma_kernel, page=page, width=width, dh=dh, dtype=q.dtype
+    )
+    out_wide = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, h, width), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), q_positions.astype(jnp.int32),
+      q2, k_view, v_view)
+    # Each head's own Dh-slot of the [H, width] accumulator.
+    out = jnp.take_along_axis(
+        out_wide.reshape(batch, h, kv, dh),
+        head_slot[None, :, None, None], axis=2,
+    )[:, :, 0]
+    return out

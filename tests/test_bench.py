@@ -84,3 +84,26 @@ def test_paged_mixed_and_adversarial_spec_benches_run():
     )
     assert worst_tps > 0
     assert worst_epp <= 2.0  # acceptance ~0: ~1 token per pass
+
+
+def test_paged_longcontext_bench_runs_tiny():
+    """The long-context A/B leg at tiny shapes on CPU: both impls run
+    (kernel under the Pallas interpreter), logits proximity gate holds,
+    timings and agreement report for each live length."""
+    import dataclasses as dc
+
+    from bench import measure_paged_longcontext
+
+    small = dc.replace(
+        FLAGSHIP, d_model=64, n_layers=2, d_ff=128, vocab=256,
+        n_heads=4, n_kv_heads=2,
+    )
+    times, agree = measure_paged_longcontext(
+        small, slots=2, page_size=4, lives=(8, 24), n_steps=4,
+        max_seq=64,
+    )
+    for impl in ("gather", "kernel"):
+        for live in (8, 24):
+            assert times[(impl, live)] > 0
+    assert set(agree) == {8, 24}
+    assert all(0.0 <= v <= 1.0 for v in agree.values())
