@@ -255,6 +255,14 @@ class RuntimeConfig:
     # (models/serving.py): concurrent requests share one page pool and
     # one batched decode step.
     payload_serving: str = ""
+    # Paged DECODE attention impl ([payload] paged_attention): "" =
+    # "auto" (the Pallas block-table kernel in its measured win domain —
+    # TPU, long caps, big pages — gather elsewhere); "gather" forces the
+    # bit-stable padded-gather path (the kernel is numerically
+    # equivalent within bf16 rounding, not bit-identical); "kernel"
+    # forces the kernel. The deployment-level escape hatch for the
+    # trace-time auto policy (models/kvcache.py _use_paged_kernel).
+    payload_paged_attention: str = ""
     # Paged-backend pool sizing ([payload] serving_*): how many requests
     # decode concurrently (slots), the KV page granule (page_size), and
     # the total page pool. pages = 0 auto-sizes the pool so every slot
@@ -410,6 +418,10 @@ class RuntimeConfig:
                 payload_serving=str(
                     payload_doc.get("serving", cls.payload_serving)
                 ),
+                payload_paged_attention=str(
+                    payload_doc.get("paged_attention",
+                                    cls.payload_paged_attention)
+                ),
                 serving_slots=int(
                     payload_doc.get("serving_slots", cls.serving_slots)
                 ),
@@ -482,6 +494,13 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving must be '', 'contiguous', or 'paged', "
                 f"got {self.payload_serving!r}"
+            )
+        if self.payload_paged_attention not in ("", "auto", "kernel",
+                                                "gather"):
+            raise RuntimeConfigError(
+                "[payload] paged_attention must be '', 'auto', "
+                f"'kernel', or 'gather', got "
+                f"{self.payload_paged_attention!r}"
             )
         if self.serving_slots < 1:
             raise RuntimeConfigError("[payload] serving_slots must be >= 1")
@@ -588,6 +607,7 @@ class RuntimeConfig:
             f"kind = {s(self.payload)}\n"
             f"attention = {s(self.payload_attention)}\n"
             f"serving = {s(self.payload_serving)}\n"
+            f"paged_attention = {s(self.payload_paged_attention)}\n"
             f"serving_slots = {self.serving_slots}\n"
             f"serving_page_size = {self.serving_page_size}\n"
             f"serving_pages = {self.serving_pages}\n"

@@ -171,6 +171,7 @@ def derive_model_config(cfg: RuntimeConfig, *, seq: int):
         expert_capacity_factor=float(capacity),
         pipeline_stages=stages if stages > 1 else 0,
         pipeline_schedule=spec.pipeline_schedule or "gpipe",
+        paged_attention=cfg.payload_paged_attention or "auto",
     )
     try:
         # Cross-field architecture errors (d_model % n_heads, GQA head

@@ -190,3 +190,21 @@ def test_serving_window_and_auto_speculative_round_trip():
                 "serving_speculative = -1"):
         with pytest.raises(RuntimeConfigError):
             RuntimeConfig.parse(f"[payload]\n{bad}\n")
+
+
+def test_paged_attention_knob_round_trips_and_threads():
+    cfg = RuntimeConfig.parse(
+        "[payload]\nserving = 'paged'\npaged_attention = 'gather'\n"
+    )
+    assert cfg.payload_paged_attention == "gather"
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse("[payload]\npaged_attention = 'fast'\n")
+    # Threads into the derived model config (the deployment-level
+    # escape hatch for the kernel's auto policy).
+    from kvedge_tpu.runtime.workload import derive_model_config
+
+    tcfg, _ = derive_model_config(cfg, seq=32)
+    assert tcfg.paged_attention == "gather"
+    tcfg, _ = derive_model_config(RuntimeConfig.parse(""), seq=32)
+    assert tcfg.paged_attention == "auto"
