@@ -273,6 +273,14 @@ class RuntimeConfig:
     serving_slots: int = 4
     serving_page_size: int = 16
     serving_pages: int = 0
+    # KV-cache storage dtype for the paged backend: "" = the compute
+    # dtype (bf16, bit-exact vs the contiguous backend); "int8" =
+    # per-token-row symmetric quantization with fp32 scales — the
+    # per-token KV HBM bill roughly HALVES, doubling servable
+    # context/slots on the same pool budget. Lossy (error bounded by
+    # one int8 step of each row's amax; decode can diverge at
+    # near-ties), so it is an explicit opt-in, never a default.
+    serving_kv_dtype: str = ""
     # Prefill granule for the paged backend: prompts land in chunks of
     # this many tokens, with the admission lock released between chunks
     # (in-flight decode proceeds) and one compiled program per chunk
@@ -432,6 +440,10 @@ class RuntimeConfig:
                 serving_pages=int(
                     payload_doc.get("serving_pages", cls.serving_pages)
                 ),
+                serving_kv_dtype=str(
+                    payload_doc.get("serving_kv_dtype",
+                                    cls.serving_kv_dtype)
+                ),
                 serving_prefill_chunk=int(
                     payload_doc.get("serving_prefill_chunk",
                                     cls.serving_prefill_chunk)
@@ -512,6 +524,23 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving_pages must be >= 0 (0 = auto-size so "
                 "every slot fits a worst-case request)"
+            )
+        if self.serving_kv_dtype not in ("", "int8"):
+            raise RuntimeConfigError(
+                "[payload] serving_kv_dtype must be '' (compute dtype) "
+                f"or 'int8', got {self.serving_kv_dtype!r}"
+            )
+        if (self.serving_kv_dtype == "int8"
+                and self.payload_paged_attention == "kernel"):
+            # The decode kernel streams raw pages and has no fused
+            # dequant; silently dropping a forced "kernel" would hide
+            # the gather's cap-sized cost at the exact long-context
+            # shapes the force exists for — refuse the combination.
+            raise RuntimeConfigError(
+                "[payload] paged_attention = 'kernel' does not support "
+                "serving_kv_dtype = 'int8' (the kernel has no fused "
+                "dequant yet); use paged_attention = '' or 'gather' "
+                "with int8 KV"
             )
         if self.serving_prefill_chunk < 0:
             raise RuntimeConfigError(
@@ -611,6 +640,7 @@ class RuntimeConfig:
             f"serving_slots = {self.serving_slots}\n"
             f"serving_page_size = {self.serving_page_size}\n"
             f"serving_pages = {self.serving_pages}\n"
+            f"serving_kv_dtype = {s(self.serving_kv_dtype)}\n"
             f"serving_prefill_chunk = {self.serving_prefill_chunk}\n"
             "serving_prefix_cache = "
             f"{'true' if self.serving_prefix_cache else 'false'}\n"

@@ -44,13 +44,23 @@ from kvedge_tpu.models.transformer import (
 @dataclasses.dataclass
 class PagedState:
     """Device-side paged cache state (a pytree; host policy lives in
-    :class:`PagedKVCache`)."""
+    :class:`PagedKVCache`).
+
+    ``scale_k``/``scale_v`` ([L, P, page, K] fp32) exist only for an
+    int8-quantized pool (``kv_dtype="int8"``): each token row of each
+    kv head carries one scale — the standard per-token KV quantization
+    — and the pools hold ``round(x / scale)`` int8. None (the bf16
+    default) keeps every compiled program identical to the
+    pre-quantization ones (None is an empty pytree node).
+    """
 
     pool_k: jax.Array   # [L, P, page, K, Dh]
     pool_v: jax.Array   # [L, P, page, K, Dh]
     tables: jax.Array   # [B, max_pages] int32 page ids (0 = also a real page;
                         # entries past a sequence's page count are unused)
     lengths: jax.Array  # [B] int32 valid positions per sequence
+    scale_k: "jax.Array | None" = None  # [L, P, page, K] fp32 (int8 only)
+    scale_v: "jax.Array | None" = None
 
     @property
     def page_size(self) -> int:
@@ -59,6 +69,24 @@ class PagedState:
     @property
     def max_seq(self) -> int:
         return self.tables.shape[1] * self.page_size
+
+
+_KV_QMAX = 127.0
+
+
+def _kv_quantize(x):
+    """Per-row symmetric int8: x [..., Dh] -> (int8 [..., Dh],
+    fp32 scale [...]). amax/127 scaling; the epsilon floor keeps an
+    all-zero row (fresh pool) from dividing by zero."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / _KV_QMAX, 1e-8)
+    q = jnp.round(xf / scale[..., None])
+    return q.astype(jnp.int8), scale
+
+
+def _kv_dequantize(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 class PagedCacheError(RuntimeError):
@@ -105,11 +133,17 @@ class PagedKVCache:
     """
 
     def __init__(self, cfg: TransformerConfig, *, slots: int, pages: int,
-                 page_size: int = 16, max_pages_per_seq: int | None = None):
+                 page_size: int = 16, max_pages_per_seq: int | None = None,
+                 kv_dtype: str = ""):
         from kvedge_tpu.models.moe import warn_if_train_serve_divergence
 
         cfg.validate()
         warn_if_train_serve_divergence(cfg)
+        if kv_dtype not in ("", "int8"):
+            raise ValueError(
+                f"kv_dtype must be '' (the compute dtype) or 'int8', "
+                f"got {kv_dtype!r}"
+            )
         self.cfg = cfg
         self.slots = slots
         self.num_pages = pages
@@ -117,7 +151,16 @@ class PagedKVCache:
         self.max_pages_per_seq = (
             max_pages_per_seq or -(-cfg.max_seq // page_size)
         )
-        dtype = jnp.dtype(cfg.dtype)
+        # int8 KV (kv_dtype="int8"): pools hold per-row-quantized int8
+        # with fp32 scales riding alongside (PagedState docstring) —
+        # the HBM bill per cached token drops ~2x (Dh bytes + 4 vs
+        # 2*Dh), which doubles servable context/slots on the same pool
+        # budget. Quantization is LOSSY (bounded by one int8 step per
+        # row amax): decode tokens may diverge from the bf16 pool at
+        # near-ties, which is why it is an explicit operator opt-in
+        # ([payload] serving_kv_dtype), never a default.
+        self.kv_quantized = kv_dtype == "int8"
+        dtype = jnp.int8 if self.kv_quantized else jnp.dtype(cfg.dtype)
         shape = (cfg.n_layers, pages, page_size, cfg.kv_heads, cfg.d_head)
         self.state = self._init_state(shape, dtype)
         self._free: list[int] = list(range(pages))[::-1]  # pop() -> lowest last
@@ -143,12 +186,20 @@ class PagedKVCache:
         (runtime/sliceserve.py) overrides this to create GLOBAL arrays
         over a multi-host mesh; everything above is host bookkeeping
         that neither knows nor cares where the pools live."""
+        def scale():
+            # Two DISTINCT arrays: the jitted steps donate the whole
+            # state, and donating one buffer twice is an error.
+            return (jnp.zeros(shape[:-1], jnp.float32)
+                    if self.kv_quantized else None)
+
         return PagedState(
             pool_k=jnp.zeros(shape, dtype),
             pool_v=jnp.zeros(shape, dtype),
             tables=jnp.zeros((self.slots, self.max_pages_per_seq),
                              jnp.int32),
             lengths=jnp.zeros((self.slots,), jnp.int32),
+            scale_k=scale(),
+            scale_v=scale(),
         )
 
     # ---- control plane (host) -------------------------------------------
@@ -293,9 +344,22 @@ class PagedKVCache:
         gather dispatch: the fresh arrays are immune to the decode
         step's buffer donation, so the (much slower) device->host
         transfer happens OUTSIDE the lock without racing a step that
-        would invalidate the pool buffers."""
+        would invalidate the pool buffers.
+
+        An int8 pool snapshots DEQUANTIZED (fp32): the persistence file
+        format stays kv_dtype-agnostic — a dump taken from an int8
+        server loads into a bf16 one and vice versa (write_pages
+        re-quantizes on the way in), at the cost of one extra
+        quantization round trip whose error is bounded by one int8 step
+        of the row's amax."""
         idx = jnp.asarray(ids, jnp.int32)
-        return self.state.pool_k[:, idx], self.state.pool_v[:, idx]
+        k, v = self.state.pool_k[:, idx], self.state.pool_v[:, idx]
+        if self.kv_quantized:
+            k = _kv_dequantize(k, self.state.scale_k[:, idx],
+                               jnp.float32)
+            v = _kv_dequantize(v, self.state.scale_v[:, idx],
+                               jnp.float32)
+        return k, v
 
     def read_pages(self, ids: list[int]):
         """Host copies of the K/V data in ``ids``: two arrays
@@ -310,8 +374,21 @@ class PagedKVCache:
         """Scatter K/V data ([L, n, page, K, Dh]) into pages ``ids`` —
         ONE batched device update per pool (a per-page loop would copy
         the whole pool once per page). The persistence load path; the
-        caller owns allocation/refcounts for these pages."""
+        caller owns allocation/refcounts for these pages. Values arrive
+        unquantized (see snapshot_pages); an int8 pool re-quantizes
+        them per row here."""
         idx = jnp.asarray(ids, jnp.int32)
+        if self.kv_quantized:
+            k_q, k_s = _kv_quantize(jnp.asarray(k_vals, jnp.float32))
+            v_q, v_s = _kv_quantize(jnp.asarray(v_vals, jnp.float32))
+            self.state = dataclasses.replace(
+                self.state,
+                pool_k=self.state.pool_k.at[:, idx].set(k_q),
+                pool_v=self.state.pool_v.at[:, idx].set(v_q),
+                scale_k=self.state.scale_k.at[:, idx].set(k_s),
+                scale_v=self.state.scale_v.at[:, idx].set(v_s),
+            )
+            return
         dtype = self.state.pool_k.dtype
         self.state = dataclasses.replace(
             self.state,
@@ -554,26 +631,32 @@ class PagedKVCache:
 # ---- jitted kernels ------------------------------------------------------
 
 
-def _gathered(state: PagedState, layer_slabs):
-    """pool[L] pages -> per-sequence contiguous [B, S_max, K, Dh] views."""
-    pool_k_l, pool_v_l = layer_slabs  # [P, page, K, Dh]
+def _gathered(state: PagedState, layer_slabs, dtype):
+    """pool[L] pages -> per-sequence contiguous [B, S_max, K, Dh] views
+    (dequantized to ``dtype`` when the pool is int8)."""
+    pool_k_l, pool_v_l, scale_k_l, scale_v_l = layer_slabs
     batch, max_pages = state.tables.shape
     page, kv, dh = pool_k_l.shape[1:]
     k = pool_k_l[state.tables]  # [B, max_pages, page, K, Dh]
     v = pool_v_l[state.tables]
+    if scale_k_l is not None:
+        k = _kv_dequantize(k, scale_k_l[state.tables], dtype)
+        v = _kv_dequantize(v, scale_v_l[state.tables], dtype)
     return (
         k.reshape(batch, max_pages * page, kv, dh),
         v.reshape(batch, max_pages * page, kv, dh),
     )
 
 
-def _scatter_token(pool, tables, lengths, kv_new, active):
+def _scatter_token(pool, scales, tables, lengths, kv_new, active):
     """Write one [B, K, Dh] token row into each sequence's current page.
 
     pool [P, page, K, Dh]; the target of row b is
     page ``tables[b, lengths[b] // page]``, offset ``lengths[b] % page``.
     Inactive slots (empty table rows would alias page 0) are routed
-    out-of-bounds and dropped.
+    out-of-bounds and dropped. ``scales`` non-None = int8 pool: the row
+    quantizes per (b, head) and its scale scatters alongside. Returns
+    ``(pool, scales)``.
     """
     pages, page = pool.shape[:2]
     page_idx = jnp.take_along_axis(
@@ -581,7 +664,10 @@ def _scatter_token(pool, tables, lengths, kv_new, active):
     )[:, 0]                                   # [B] page ids
     page_idx = jnp.where(active, page_idx, pages)  # OOB => dropped
     offset = lengths % page                    # [B]
-    return pool.at[page_idx, offset].set(kv_new, mode="drop")
+    if scales is not None:
+        kv_new, row_scale = _kv_quantize(kv_new)
+        scales = scales.at[page_idx, offset].set(row_scale, mode="drop")
+    return pool.at[page_idx, offset].set(kv_new, mode="drop"), scales
 
 
 def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
@@ -601,7 +687,8 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     h, kv, dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
     group = h // kv
     dtype = x.dtype
-    pool_k_l, pool_v_l = layer_slabs
+    pool_k_l, pool_v_l, scale_k_l, scale_v_l = layer_slabs
+    quantized = scale_k_l is not None
 
     normed = _rmsnorm(x, ln_attn)
     q, k, v = split_qkv(cfg, normed @ w_qkv.astype(dtype))
@@ -626,14 +713,17 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
         # that scores them (intra-pass causality is free: writes land
         # before the gather, and the mask is on absolute positions).
         new_pool_k, new_pool_v = pool_k_l, pool_v_l
+        new_scale_k, new_scale_v = scale_k_l, scale_v_l
         for i in range(q_len):
             w_active = (active if write_mask is None
                         else active & write_mask[:, i])
-            new_pool_k = _scatter_token(
-                new_pool_k, tables, lengths + i, k[:, i], w_active
+            new_pool_k, new_scale_k = _scatter_token(
+                new_pool_k, new_scale_k, tables, lengths + i, k[:, i],
+                w_active,
             )
-            new_pool_v = _scatter_token(
-                new_pool_v, tables, lengths + i, v[:, i], w_active
+            new_pool_v, new_scale_v = _scatter_token(
+                new_pool_v, new_scale_v, tables, lengths + i, v[:, i],
+                w_active,
             )
     else:
         # Prefill: scatter q_len rows of one slot at their ABSOLUTE
@@ -645,15 +735,24 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
         positions = q_positions[0]
         page_idx = tables[0][positions // page]
         offset = positions % page
-        new_pool_k = pool_k_l.at[page_idx, offset].set(k[0])
-        new_pool_v = pool_v_l.at[page_idx, offset].set(v[0])
+        k_rows, v_rows = k[0], v[0]
+        new_scale_k, new_scale_v = scale_k_l, scale_v_l
+        if quantized:
+            k_rows, sk = _kv_quantize(k_rows)
+            v_rows, sv = _kv_quantize(v_rows)
+            new_scale_k = scale_k_l.at[page_idx, offset].set(sk)
+            new_scale_v = scale_v_l.at[page_idx, offset].set(sv)
+        new_pool_k = pool_k_l.at[page_idx, offset].set(k_rows)
+        new_pool_v = pool_v_l.at[page_idx, offset].set(v_rows)
 
-    if (slot is None and q_len == 1
+    if (slot is None and q_len == 1 and not quantized
             and _use_paged_kernel(cfg, pool_k_l.shape[1], kv * dh)):
         # Single-query decode (steps and windows): attention directly
         # over the block table — K/V pages stream up to each row's LIVE
         # length through the Pallas kernel; the padded pool view is
-        # never materialized (ops/paged_attention.py).
+        # never materialized (ops/paged_attention.py). int8 pools take
+        # the gather (the kernel streams raw pages; fusing dequant into
+        # its page loop is future work).
         from kvedge_tpu.ops.paged_attention import paged_decode_attention
 
         att = paged_decode_attention(
@@ -664,7 +763,8 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     else:
         gk, gv = _gathered(
             dataclasses.replace(state, tables=tables),
-            (new_pool_k, new_pool_v),
+            (new_pool_k, new_pool_v, new_scale_k, new_scale_v),
+            dtype,
         )
         qg = q.reshape(batch, q_len, kv, group, dh)
         scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, gk) / (dh ** 0.5)
@@ -689,28 +789,39 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
         )
     else:
         x = x + jax.nn.gelu(normed @ w_up.astype(dtype)) @ w_down.astype(dtype)
-    return x, new_pool_k, new_pool_v
+    return x, (new_pool_k, new_pool_v, new_scale_k, new_scale_v)
 
 
 def _run_paged(cfg, params, state, x, q_positions, slot=None,
                all_positions: bool = False, write_mask=None):
     def body(carry, xs):
-        layer_params, pool_k_l, pool_v_l = xs
-        out, pool_k_l, pool_v_l = _paged_attend_layer(
-            cfg, state, carry, layer_params, (pool_k_l, pool_v_l),
+        layer_params, slabs = xs
+        out, slabs = _paged_attend_layer(
+            cfg, state, carry, layer_params, slabs,
             q_positions, slot, write_mask,
         )
-        return out, (pool_k_l, pool_v_l)
+        return out, slabs
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (stacked_layer_params(params, cfg), state.pool_k,
-                  state.pool_v)
+    x, new_slabs = jax.lax.scan(
+        body, x,
+        (stacked_layer_params(params, cfg),
+         (state.pool_k, state.pool_v, state.scale_k, state.scale_v)),
     )
     x = _rmsnorm(x, params["ln_final"])
     logits = tied_readout(
         x if all_positions else x[:, -1], params["embedding"]
     )
-    return logits, new_k, new_v
+    return logits, new_slabs
+
+
+def _with_slabs(state: PagedState, slabs, **extra) -> PagedState:
+    """A state whose pools/scales are replaced by ``slabs`` (the
+    4-tuple every paged kernel returns), plus any other field."""
+    new_k, new_v, new_sk, new_sv = slabs
+    return dataclasses.replace(
+        state, pool_k=new_k, pool_v=new_v, scale_k=new_sk,
+        scale_v=new_sv, **extra,
+    )
 
 
 def _paged_prefill_impl(params: dict, state: PagedState, prompt, slot,
@@ -721,10 +832,10 @@ def _paged_prefill_impl(params: dict, state: PagedState, prompt, slot,
     dtype = jnp.dtype(cfg.dtype)
     x = params["embedding"][prompt][None].astype(dtype)  # [1, T, D]
     q_positions = (offset + jnp.arange(prompt.shape[0]))[None]
-    logits, new_k, new_v = _run_paged(
+    logits, slabs = _run_paged(
         cfg, params, state, x, q_positions, slot
     )
-    return logits[0], dataclasses.replace(state, pool_k=new_k, pool_v=new_v)
+    return logits[0], _with_slabs(state, slabs)
 
 
 _paged_prefill = functools.partial(
@@ -747,11 +858,9 @@ def _decode_step_core(params: dict, state: PagedState, tokens,
     masked = dataclasses.replace(
         state, lengths=jnp.where(active, state.lengths, 0)
     )
-    logits, new_k, new_v = _run_paged(cfg, params, masked, x, q_positions)
-    return logits, dataclasses.replace(
-        state,
-        pool_k=new_k,
-        pool_v=new_v,
+    logits, slabs = _run_paged(cfg, params, masked, x, q_positions)
+    return logits, _with_slabs(
+        state, slabs,
         lengths=state.lengths + active.astype(jnp.int32),
     )
 
@@ -805,7 +914,7 @@ def _spec_verify_core(params: dict, state: PagedState, tokens,
     # only for rows that can accept them.
     write_mask = (spec_mask[:, None]
                   | (jnp.arange(1 + k_len) == 0)[None, :])
-    logits, new_k, new_v = _run_paged(
+    logits, slabs = _run_paged(
         cfg, params, masked, x, q_positions, all_positions=True,
         write_mask=write_mask,
     )  # [B, 1+K, V]
@@ -821,10 +930,8 @@ def _spec_verify_core(params: dict, state: PagedState, tokens,
         jnp.concatenate([draft, y[:, -1:]], axis=1),
         jnp.take_along_axis(y, accepted[:, None], axis=1),
     ).astype(jnp.int32)
-    state = dataclasses.replace(
-        state,
-        pool_k=new_k,
-        pool_v=new_v,
+    state = _with_slabs(
+        state, slabs,
         lengths=state.lengths + active.astype(jnp.int32) * (1 + accepted),
     )
     return emitted, accepted, logits[:, 0], state

@@ -169,7 +169,8 @@ class PagedGenerationServer:
     def __init__(self, params: dict, cfg, *, slots: int = 4,
                  pages: int = 64, page_size: int = 16,
                  prefill_chunk: int = 0, prefix_cache: bool = True,
-                 speculative: int = 0, window: int = 64, cache=None):
+                 speculative: int = 0, window: int = 64,
+                 kv_dtype: str = "", cache=None):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
@@ -224,6 +225,7 @@ class PagedGenerationServer:
             cfg, slots=slots, pages=pages, page_size=page_size,
             max_pages_per_seq=-(-(cfg.max_seq + self._spec)
                                 // page_size),
+            kv_dtype=kv_dtype,
         )
         # Prefix sharing: completed prompts register their page-aligned
         # prefixes here (key: token tuple -> pinned pages + LRU stamp);

@@ -59,7 +59,7 @@ from kvedge_tpu.models.kvcache import (
 _HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
 
 
-def _slice_kernels(mesh, cfg):
+def _slice_kernels(mesh, cfg, quantized: bool = False):
     """The paged kernels re-jitted with pinned output shardings: the
     K/V pools shard over the ``model`` axis on the kv-heads dim (the
     per-token K/V a model-sharded layer produces is already
@@ -76,12 +76,20 @@ def _slice_kernels(mesh, cfg):
     rep = NamedSharding(mesh, P())
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     model = axis_sizes.get("model", 1)
+    head_sharded = model > 1 and cfg.kv_heads % model == 0
     pool_sh = (
         NamedSharding(mesh, P(None, None, None, "model", None))
-        if model > 1 and cfg.kv_heads % model == 0 else rep
+        if head_sharded else rep
+    )
+    # int8 scales [L, P, page, K] shard with the pool's kv-head dim.
+    scale_sh = (
+        (NamedSharding(mesh, P(None, None, None, "model"))
+         if head_sharded else rep)
+        if quantized else None
     )
     state_sh = PagedState(
-        pool_k=pool_sh, pool_v=pool_sh, tables=rep, lengths=rep
+        pool_k=pool_sh, pool_v=pool_sh, tables=rep, lengths=rep,
+        scale_k=scale_sh, scale_v=scale_sh,
     )
     prefill = jax.jit(
         _paged_prefill_impl, static_argnames=("cfg",),
@@ -125,18 +133,21 @@ class SlicePagedKVCache(PagedKVCache):
     """
 
     def __init__(self, cfg, *, slots: int, pages: int, page_size: int,
-                 mesh, max_pages_per_seq: int | None = None):
+                 mesh, max_pages_per_seq: int | None = None,
+                 kv_dtype: str = ""):
         import jax
 
         self.mesh = mesh
         (self._rep, self._state_sh, self._k_prefill, self._k_step,
          self._k_window, self._k_spec,
-         self._k_wsample) = _slice_kernels(mesh, cfg)
+         self._k_wsample) = _slice_kernels(
+             mesh, cfg, quantized=kv_dtype == "int8"
+         )
         self._is_leader = jax.process_index() == 0
         self._stopped = False
         super().__init__(
             cfg, slots=slots, pages=pages, page_size=page_size,
-            max_pages_per_seq=max_pages_per_seq,
+            max_pages_per_seq=max_pages_per_seq, kv_dtype=kv_dtype,
         )
 
     # ---- refused host I/O ------------------------------------------------
@@ -170,12 +181,20 @@ class SlicePagedKVCache(PagedKVCache):
         import jax.numpy as jnp
 
         slots, mpps = self.slots, self.max_pages_per_seq
+        quantized = self.kv_quantized
+
+        def scale():
+            return (jnp.zeros(shape[:-1], jnp.float32)
+                    if quantized else None)
+
         return jax.jit(
             lambda: PagedState(
                 pool_k=jnp.zeros(shape, dtype),
                 pool_v=jnp.zeros(shape, dtype),
                 tables=jnp.zeros((slots, mpps), jnp.int32),
                 lengths=jnp.zeros((slots,), jnp.int32),
+                scale_k=scale(),
+                scale_v=scale(),
             ),
             out_shardings=self._state_sh,
         )()

@@ -208,3 +208,22 @@ def test_paged_attention_knob_round_trips_and_threads():
     assert tcfg.paged_attention == "gather"
     tcfg, _ = derive_model_config(RuntimeConfig.parse(""), seq=32)
     assert tcfg.paged_attention == "auto"
+
+
+def test_serving_kv_dtype_round_trips_and_validates():
+    cfg = RuntimeConfig.parse(
+        "[payload]\nserving = 'paged'\nserving_kv_dtype = 'int8'\n"
+    )
+    assert cfg.serving_kv_dtype == "int8"
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    assert RuntimeConfig.parse("").serving_kv_dtype == ""
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse("[payload]\nserving_kv_dtype = 'fp8'\n")
+
+
+def test_kernel_with_int8_kv_refused():
+    with pytest.raises(RuntimeConfigError, match="fused dequant"):
+        RuntimeConfig.parse(
+            "[payload]\npaged_attention = 'kernel'\n"
+            "serving_kv_dtype = 'int8'\n"
+        )
