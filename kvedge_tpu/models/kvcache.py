@@ -144,6 +144,15 @@ class PagedKVCache:
                 f"kv_dtype must be '' (the compute dtype) or 'int8', "
                 f"got {kv_dtype!r}"
             )
+        if kv_dtype == "int8" and cfg.paged_attention == "kernel":
+            # Same refusal the config layer makes — enforced here too so
+            # a direct construction cannot silently downgrade a FORCED
+            # kernel to the cap-sized gather (the kernel has no fused
+            # dequant).
+            raise ValueError(
+                "paged_attention='kernel' does not support int8 KV "
+                "(no fused dequant); use 'auto' or 'gather'"
+            )
         self.cfg = cfg
         self.slots = slots
         self.num_pages = pages
@@ -346,29 +355,43 @@ class PagedKVCache:
         transfer happens OUTSIDE the lock without racing a step that
         would invalidate the pool buffers.
 
-        An int8 pool snapshots DEQUANTIZED (fp32): the persistence file
-        format stays kv_dtype-agnostic — a dump taken from an int8
-        server loads into a bf16 one and vice versa (write_pages
-        re-quantizes on the way in), at the cost of one extra
-        quantization round trip whose error is bounded by one int8 step
-        of the row's amax."""
+        An int8 pool snapshots AS STORED (int8 values + fp32 scales —
+        a 2-or-4 tuple): dequantizing on device would make the
+        device->host transfer ~4x the bytes the pool actually holds,
+        on exactly the configs int8 exists to relieve.
+        :meth:`snapshot_to_host` dequantizes host-side, so the
+        persistence FILE format stays kv_dtype-agnostic — a dump taken
+        from an int8 server loads into a bf16 one and vice versa
+        (write_pages re-quantizes on the way in), at the cost of one
+        extra quantization round trip whose error is bounded by one
+        int8 step of the row's amax."""
         idx = jnp.asarray(ids, jnp.int32)
-        k, v = self.state.pool_k[:, idx], self.state.pool_v[:, idx]
+        out = [self.state.pool_k[:, idx], self.state.pool_v[:, idx]]
         if self.kv_quantized:
-            k = _kv_dequantize(k, self.state.scale_k[:, idx],
-                               jnp.float32)
-            v = _kv_dequantize(v, self.state.scale_v[:, idx],
-                               jnp.float32)
-        return k, v
+            out += [self.state.scale_k[:, idx],
+                    self.state.scale_v[:, idx]]
+        return tuple(out)
 
-    def read_pages(self, ids: list[int]):
-        """Host copies of the K/V data in ``ids``: two arrays
-        ``[L, n, page, K, Dh]``. One gather + transfer per pool — the
-        prefix-persistence dump path (models/serving.py)."""
+    @staticmethod
+    def snapshot_to_host(snapshot):
+        """Host fp32 ``(k, v)`` from a :meth:`snapshot_pages` tuple —
+        the transfer (compact, as-stored) then the dequant (host-side,
+        cheap numpy)."""
         import numpy as np
 
-        k_dev, v_dev = self.snapshot_pages(ids)
-        return np.asarray(k_dev), np.asarray(v_dev)
+        if len(snapshot) == 2:
+            k, v = (np.asarray(x, np.float32) for x in snapshot)
+            return k, v
+        k, v, sk, sv = (np.asarray(x) for x in snapshot)
+        return (k.astype(np.float32) * sk[..., None].astype(np.float32),
+                v.astype(np.float32) * sv[..., None].astype(np.float32))
+
+    def read_pages(self, ids: list[int]):
+        """Host fp32 copies of the K/V data in ``ids``: two arrays
+        ``[L, n, page, K, Dh]`` (dequantized for int8 pools). One
+        gather + transfer per array — the prefix-persistence dump path
+        (models/serving.py)."""
+        return self.snapshot_to_host(self.snapshot_pages(ids))
 
     def write_pages(self, ids: list[int], k_vals, v_vals) -> None:
         """Scatter K/V data ([L, n, page, K, Dh]) into pages ``ids`` —

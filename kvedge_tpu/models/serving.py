@@ -598,11 +598,12 @@ class PagedGenerationServer:
             # device->host transfer below happens with decode running
             # — a periodic dump must not freeze token emission for the
             # duration of a multi-hundred-MB copy.
-            k_dev, v_dev = self._cache.snapshot_pages(page_ids)
-        # npz has no bfloat16; float32 holds bf16 (and fp16) exactly,
-        # and the load path casts back to the pool dtype.
-        pool_k = np.asarray(k_dev, np.float32)
-        pool_v = np.asarray(v_dev, np.float32)
+            snapshot = self._cache.snapshot_pages(page_ids)
+        # Transfer as stored (int8 pools ship compact + scales), then
+        # dequantize host-side. npz has no bfloat16; float32 holds bf16
+        # (and fp16) exactly, and the load path casts back (or
+        # re-quantizes) to the pool dtype.
+        pool_k, pool_v = self._cache.snapshot_to_host(snapshot)
         doc = {
             "fingerprint": fingerprint,
             "page_size": self._cache.page_size,
@@ -924,6 +925,9 @@ class PagedGenerationServer:
                 "free_slots": len(self._free_slots),
                 "free_pages": self._cache.free_pages(),
                 "reserved_pages": self._reserved,
+                "window": self._window,
+                "kv_dtype": ("int8" if self._cache.kv_quantized
+                             else str(self._cfg.dtype)),
                 "prefix_entries": len(self._prefix_entry_nodes),
                 "prefix_hits": self._prefix_hits,
                 "prefix_tokens_saved": self._prefix_tokens_saved,

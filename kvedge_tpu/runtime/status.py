@@ -92,6 +92,9 @@ _SERVE_METRIC_FIELDS = (
     ("prefix_tokens_saved", "serve_prefix_tokens_saved_total", "counter",
      "prompt tokens whose prefill was skipped via prefix sharing "
      "(paged backend)"),
+    ("window", "serve_window", "gauge",
+     "device decode window cap in steps (paged backend, "
+     "serving_window)"),
     ("spec_passes", "serve_spec_passes_total", "counter",
      "speculative verify passes run (paged backend, "
      "serving_speculative > 0)"),
