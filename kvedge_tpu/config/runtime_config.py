@@ -530,18 +530,6 @@ class RuntimeConfig:
                 "[payload] serving_kv_dtype must be '' (compute dtype) "
                 f"or 'int8', got {self.serving_kv_dtype!r}"
             )
-        if (self.serving_kv_dtype == "int8"
-                and self.payload_paged_attention == "kernel"):
-            # The decode kernel streams raw pages and has no fused
-            # dequant; silently dropping a forced "kernel" would hide
-            # the gather's cap-sized cost at the exact long-context
-            # shapes the force exists for — refuse the combination.
-            raise RuntimeConfigError(
-                "[payload] paged_attention = 'kernel' does not support "
-                "serving_kv_dtype = 'int8' (the kernel has no fused "
-                "dequant yet); use paged_attention = '' or 'gather' "
-                "with int8 KV"
-            )
         if self.serving_prefill_chunk < 0:
             raise RuntimeConfigError(
                 "[payload] serving_prefill_chunk must be >= 0 "

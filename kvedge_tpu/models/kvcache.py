@@ -144,15 +144,6 @@ class PagedKVCache:
                 f"kv_dtype must be '' (the compute dtype) or 'int8', "
                 f"got {kv_dtype!r}"
             )
-        if kv_dtype == "int8" and cfg.paged_attention == "kernel":
-            # Same refusal the config layer makes — enforced here too so
-            # a direct construction cannot silently downgrade a FORCED
-            # kernel to the cap-sized gather (the kernel has no fused
-            # dequant).
-            raise ValueError(
-                "paged_attention='kernel' does not support int8 KV "
-                "(no fused dequant); use 'auto' or 'gather'"
-            )
         self.cfg = cfg
         self.slots = slots
         self.num_pages = pages
@@ -169,6 +160,21 @@ class PagedKVCache:
         # near-ties, which is why it is an explicit operator opt-in
         # ([payload] serving_kv_dtype), never a default.
         self.kv_quantized = kv_dtype == "int8"
+        if self.kv_quantized and cfg.paged_attention == "kernel":
+            from kvedge_tpu.ops.paged_attention import scales_fit_vmem
+
+            if not scales_fit_vmem(pages * page_size * cfg.kv_heads):
+                # A forced kernel that cannot run must refuse at
+                # construction, not silently degrade to the cap-sized
+                # gather at the long-context shapes the force exists
+                # for.
+                raise ValueError(
+                    "paged_attention='kernel' with int8 KV needs both "
+                    "scale arrays to fit the kernel's VMEM budget; "
+                    f"this pool ({pages} pages x {page_size} x "
+                    f"{cfg.kv_heads} kv heads) exceeds it — shrink the "
+                    "pool/page geometry or use 'auto'/'gather'"
+                )
         dtype = jnp.int8 if self.kv_quantized else jnp.dtype(cfg.dtype)
         shape = (cfg.n_layers, pages, page_size, cfg.kv_heads, cfg.d_head)
         self.state = self._init_state(shape, dtype)
@@ -768,18 +774,36 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
         new_pool_k = pool_k_l.at[page_idx, offset].set(k_rows)
         new_pool_v = pool_v_l.at[page_idx, offset].set(v_rows)
 
-    if (slot is None and q_len == 1 and not quantized
+    # int8 pools use the kernel too (pages stream AS STORED — half the
+    # DMA bytes — with scales folded in post-dot), as long as both
+    # whole scale arrays fit the kernel's VMEM budget. "auto" routes
+    # oversized pools to the gather; a FORCED kernel that cannot run
+    # refuses loudly (PagedKVCache.__init__ rejects it up front; this
+    # trace-time raise is the defense for direct kernel callers).
+    if quantized:
+        from kvedge_tpu.ops.paged_attention import scales_fit_vmem
+
+        scales_fit = scales_fit_vmem(new_scale_k.size)
+        if cfg.paged_attention == "kernel" and not scales_fit:
+            raise ValueError(
+                "paged_attention='kernel' forced but the int8 scale "
+                f"arrays ({new_scale_k.size} fp32 elements x2) exceed "
+                "the kernel's VMEM budget — shrink the pool/page "
+                "geometry or use 'auto'/'gather'"
+            )
+    else:
+        scales_fit = True
+    if (slot is None and q_len == 1 and scales_fit
             and _use_paged_kernel(cfg, pool_k_l.shape[1], kv * dh)):
         # Single-query decode (steps and windows): attention directly
         # over the block table — K/V pages stream up to each row's LIVE
         # length through the Pallas kernel; the padded pool view is
-        # never materialized (ops/paged_attention.py). int8 pools take
-        # the gather (the kernel streams raw pages; fusing dequant into
-        # its page loop is future work).
+        # never materialized (ops/paged_attention.py).
         from kvedge_tpu.ops.paged_attention import paged_decode_attention
 
         att = paged_decode_attention(
             q[:, 0], new_pool_k, new_pool_v, tables, q_positions[:, 0],
+            scale_k=new_scale_k, scale_v=new_scale_v,
             interpret=jax.default_backend() != "tpu",
         )  # [B, H, Dh], kv-major head layout — same as the einsum's
         x = x + att.reshape(batch, 1, h * dh) @ w_out.astype(dtype)

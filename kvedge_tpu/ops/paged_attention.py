@@ -59,6 +59,122 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+_SCALE_VMEM_BUDGET = 8 * 1024 * 1024  # bytes, BOTH scale arrays
+
+
+def scales_fit_vmem(scale_elements: int) -> bool:
+    """Whether the int8 kernel variant can run: it maps BOTH whole
+    scale arrays ([P, page, K] fp32 each, ``scale_elements`` elements
+    per array) into VMEM alongside its page buffers. The policy lives
+    here, next to the mechanism — callers route to the gather ("auto")
+    or refuse loudly (forced "kernel") when this is False."""
+    return 2 * scale_elements * 4 <= _SCALE_VMEM_BUDGET
+
+
+def _decode_dma_kernel_int8(tables_ref, pos_ref, q_ref, scale_k_ref,
+                            scale_v_ref, k_hbm, v_hbm, o_ref, kbuf,
+                            vbuf, sems, *, page: int, width: int,
+                            dh: int, group: int, dtype):
+    """The int8-pool variant: pages stream AS STORED (int8 — half the
+    DMA bytes of bf16, on exactly the configs int8 KV exists for) and
+    the per-row scales fold in POST-DOT. Soundness: query head
+    h = k'*group + g reads only kv slot k' — its scores touch only
+    columns whose K-scale is ``s_k[p, k']``, so
+    ``score[h, p] = raw[h, p] * s_k[p, k']`` dequantizes K exactly;
+    and only slot k' of its accumulator row is extracted by the
+    caller, so folding ``s_v[p, k']`` into the probability row
+    (``p'[h, p] = p[h, p] * s_v[p, k']``) dequantizes V exactly for
+    everything that is read (other slots' columns hold garbage no one
+    extracts). The scale arrays ([P, page, K] fp32 — a few MB) sit
+    whole in VMEM and are indexed by page id, no extra DMA."""
+    b = pl.program_id(0)
+    q_pos = pos_ref[b]
+    n_pages = q_pos // page + 1
+
+    def dma(slot, j, hbm, buf, which):
+        return pltpu.make_async_copy(
+            hbm.at[tables_ref[b, j]], buf.at[slot],
+            sems.at[slot, which],
+        )
+
+    dma(0, 0, k_hbm, kbuf, 0).start()
+    dma(0, 0, v_hbm, vbuf, 1).start()
+
+    q2 = q_ref[0]  # [H, width] int8-dot-ready? no — compute dtype
+    h = q2.shape[0]
+    kv = width // dh
+    scale = jnp.asarray(dh ** 0.5, dtype)
+
+    # [H, K] one-hot of each head's kv slot (heads are kv-major): the
+    # scale selection becomes a tiny dot — Mosaic-friendly where
+    # column-slice + concat is not.
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (h, kv), 0) // group
+        == jax.lax.broadcasted_iota(jnp.int32, (h, kv), 1)
+    ).astype(jnp.float32)
+
+    def per_head(s_pk):
+        """[page, K] scales -> [H, page] selection by each head's own
+        kv slot."""
+        return jax.lax.dot_general(
+            onehot, s_pk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def body(j, carry):
+        m_prev, l_prev, acc_prev = carry
+        slot = j % 2
+
+        @pl.when(j + 1 < n_pages)
+        def _():
+            dma((j + 1) % 2, j + 1, k_hbm, kbuf, 0).start()
+            dma((j + 1) % 2, j + 1, v_hbm, vbuf, 1).start()
+
+        dma(slot, j, k_hbm, kbuf, 0).wait()
+        dma(slot, j, v_hbm, vbuf, 1).wait()
+
+        pg = tables_ref[b, j]
+        sk = per_head(scale_k_ref[pg])  # [H, page] fp32
+        sv = per_head(scale_v_ref[pg])
+        kj = kbuf[slot]  # [page, width] int8
+        vj = vbuf[slot]
+        raw = jax.lax.dot_general(
+            q2.astype(jnp.float32), kj.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [H, page]
+        s32 = raw * sk  # K dequant folded post-dot (exact per head)
+        # Mirror the gather path's visible rounding: dtype scores,
+        # dtype scale division, fp32 softmax.
+        s16 = s32.astype(dtype) / scale
+        key_pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, s16.shape, 1
+        )
+        s = jnp.where(
+            key_pos <= q_pos, s16, jnp.finfo(dtype).min
+        ).astype(jnp.float32)
+
+        m_new = jnp.maximum(
+            m_prev, jnp.max(s, axis=-1, keepdims=True)
+        )
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * correction + jax.lax.dot_general(
+            p * sv, vj.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # V dequant folded into p (exact for each head's own slot)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((h, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((h, 1), jnp.float32)
+    acc0 = jnp.zeros((h, width), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
 def _decode_dma_kernel(tables_ref, pos_ref, q_ref, k_hbm, v_hbm, o_ref,
                        kbuf, vbuf, sems, *, page: int, width: int,
                        dh: int, dtype):
@@ -151,7 +267,8 @@ def _decode_dma_kernel(tables_ref, pos_ref, q_ref, k_hbm, v_hbm, o_ref,
 
 
 def paged_decode_attention(q, pool_k, pool_v, tables, q_positions,
-                           *, interpret: bool = False):
+                           *, scale_k=None, scale_v=None,
+                           interpret: bool = False):
     """Decode attention over a paged KV pool, block-table-indexed.
 
     q [B, H, Dh] (post-rotary, ONE query token per sequence, kv-major
@@ -159,13 +276,17 @@ def paged_decode_attention(q, pool_k, pool_v, tables, q_positions,
     pool_k/pool_v [P, page, K, Dh]; tables [B, max_pages] int32;
     q_positions [B] int32 (row b attends key positions 0..q_positions[b],
     whose K/V — including the current token's — are already scattered).
-    Returns [B, H, Dh]. Cost scales with each row's LIVE page count.
+    ``scale_k``/``scale_v`` ([P, page, K] fp32) mark an int8 pool: the
+    int8 kernel variant streams pages as stored and folds the scales in
+    post-dot. Returns [B, H, Dh]. Cost scales with each row's LIVE page
+    count.
     """
     batch, h, dh = q.shape
     pages_total, page, kv, _ = pool_k.shape
     _, max_pages = tables.shape
     group = h // kv
     width = kv * dh
+    quantized = scale_k is not None
     if width % 128 and not interpret:
         raise ValueError(
             f"paged decode kernel needs kv_heads * d_head to be a "
@@ -186,31 +307,52 @@ def paged_decode_attention(q, pool_k, pool_v, tables, q_positions,
     place = (head_slot[:, None] == col_slot[None, :])  # [H, width]
     q2 = jnp.where(place[None], jnp.tile(q, (1, 1, kv)), 0)
 
+    q_spec = pl.BlockSpec((1, h, width), lambda b, t, p: (b, 0, 0))
+    pool_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),  # pools stay in HBM;
+        pl.BlockSpec(memory_space=pl.ANY),  # the kernel DMAs pages
+    ]
+    scratch = [
+        pltpu.VMEM((2, page, width), pool_k.dtype),
+        pltpu.VMEM((2, page, width), pool_v.dtype),
+        pltpu.SemaphoreType.DMA((2, 2)),
+    ]
+    if quantized:
+        # Scale arrays ride whole in VMEM (a few MB) and are indexed
+        # by page id — no extra DMA machinery.
+        in_specs = [q_spec,
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                    *pool_specs]
+        kernel = functools.partial(
+            _decode_dma_kernel_int8, page=page, width=width, dh=dh,
+            group=group, dtype=q.dtype,
+        )
+        args = (tables.astype(jnp.int32), q_positions.astype(jnp.int32),
+                q2, scale_k.astype(jnp.float32),
+                scale_v.astype(jnp.float32), k_view, v_view)
+    else:
+        in_specs = [q_spec, *pool_specs]
+        kernel = functools.partial(
+            _decode_dma_kernel, page=page, width=width, dh=dh,
+            dtype=q.dtype,
+        )
+        args = (tables.astype(jnp.int32), q_positions.astype(jnp.int32),
+                q2, k_view, v_view)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(batch,),
-        in_specs=[
-            pl.BlockSpec((1, h, width), lambda b, t, p: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),  # pools stay in HBM;
-            pl.BlockSpec(memory_space=pl.ANY),  # the kernel DMAs pages
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, width), lambda b, t, p: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, page, width), pool_k.dtype),
-            pltpu.VMEM((2, page, width), pool_v.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
-    )
-    kernel = functools.partial(
-        _decode_dma_kernel, page=page, width=width, dh=dh, dtype=q.dtype
+        scratch_shapes=scratch,
     )
     out_wide = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((batch, h, width), q.dtype),
         interpret=interpret,
-    )(tables.astype(jnp.int32), q_positions.astype(jnp.int32),
-      q2, k_view, v_view)
+    )(*args)
     # Each head's own Dh-slot of the [H, width] accumulator.
     out = jnp.take_along_axis(
         out_wide.reshape(batch, h, kv, dh),

@@ -193,3 +193,46 @@ def test_kv_bytes_metric_halves():
     assert bf16 == CFG.n_layers * 2 * CFG.kv_heads * CFG.d_head * 2
     assert i8 == CFG.n_layers * 2 * CFG.kv_heads * (CFG.d_head + 4)
     assert i8 < 0.8 * bf16  # d_head 8 here; ~0.53x at d_head 64
+
+
+def test_int8_kernel_path_matches_int8_gather(params):
+    """The Pallas kernel's int8 variant (pages stream as stored, scales
+    folded post-dot): a decode step through paged_attention='kernel' +
+    int8 produces logits within numeric tolerance of the int8 gather
+    path on the same quantized pool (interpret mode on CPU). Logits,
+    not token sequences: a wrong page or wrong scale slot moves logits
+    by whole units (measured legitimate diff ~4e-3 here), while token
+    sequences cascade at this tiny model's sub-noise top-2 gaps. A
+    window runs afterwards as a smoke of the scan path."""
+    prompts = {0: [5, 9, 2], 1: [7, 7, 7, 7, 7]}
+
+    def step_logits(paged_attention):
+        cfg = dataclasses.replace(CFG, paged_attention=paged_attention)
+        c = PagedKVCache(cfg, slots=2, pages=16, page_size=4,
+                         kv_dtype="int8")
+        toks = np.zeros((2,), np.int32)
+        for s, pr in prompts.items():
+            c.admit(s, len(pr))
+            logits = c.prefill(params, s, jnp.asarray(pr, jnp.int32))
+            toks[s] = int(jnp.argmax(logits))
+        logits = np.asarray(c.step(params, jnp.asarray(toks)),
+                            np.float32)
+        nxt = jnp.asarray(np.argmax(logits, -1), jnp.int32)
+        window = np.asarray(c.step_window(params, nxt, 6))
+        return logits, window
+
+    lk, wk = step_logits("kernel")
+    lg, wg = step_logits("gather")
+    np.testing.assert_allclose(lk, lg, atol=0.05, rtol=0.05)
+    assert wk.shape == wg.shape == (6, 2)
+
+
+def test_forced_kernel_oversized_scales_refused():
+    """A forced kernel whose int8 scale arrays exceed the VMEM budget
+    refuses at construction — never a silent downgrade to the gather."""
+    big = dataclasses.replace(CFG, paged_attention="kernel",
+                              max_seq=64)
+    with pytest.raises(ValueError, match="VMEM budget"):
+        # 2M pages x 4 x 2 kv heads = 16M fp32 elements per array.
+        PagedKVCache(big, slots=2, pages=2_000_000, page_size=4,
+                     kv_dtype="int8")

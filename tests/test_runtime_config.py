@@ -219,11 +219,3 @@ def test_serving_kv_dtype_round_trips_and_validates():
     assert RuntimeConfig.parse("").serving_kv_dtype == ""
     with pytest.raises(RuntimeConfigError):
         RuntimeConfig.parse("[payload]\nserving_kv_dtype = 'fp8'\n")
-
-
-def test_kernel_with_int8_kv_refused():
-    with pytest.raises(RuntimeConfigError, match="fused dequant"):
-        RuntimeConfig.parse(
-            "[payload]\npaged_attention = 'kernel'\n"
-            "serving_kv_dtype = 'int8'\n"
-        )
