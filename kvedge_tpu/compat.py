@@ -1,0 +1,48 @@
+"""Version shims for jax APIs with moved/renamed surfaces.
+
+The repo targets the current jax API; these wrappers keep it importable
+and correct on older releases baked into some containers, where the same
+operation exists under a different name. Centralized so every call site
+states the MODERN spelling and the translation lives in exactly one
+place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    _shard_map = jax.shard_map  # public since jax 0.6
+    _MODERN = True
+except AttributeError:  # older jax keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _MODERN = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None):
+    """``jax.shard_map`` with the modern keyword surface on any jax.
+
+    Translations for the experimental-era API:
+
+    * ``axis_names`` (the axes that go MANUAL) becomes ``auto`` (its
+      complement — the axes that stay automatic);
+    * ``check_vma`` becomes ``check_rep`` (same meaning, old name).
+    """
+    kw = {}
+    if _MODERN:
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    # The legacy replication checker has false positives the modern
+    # check_vma pass fixed (e.g. "branches of cond produced mismatched
+    # replication types" on ring attention's rotation cond), so it stays
+    # off on legacy jax.
+    kw["check_rep"] = False
+    return _shard_map(f, mesh, in_specs, out_specs, **kw)

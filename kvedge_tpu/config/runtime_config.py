@@ -20,7 +20,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # python < 3.11: same API under the old name
+    import tomli as tomllib  # type: ignore[no-redef]
 from typing import Mapping
 
 

@@ -114,12 +114,17 @@ def _use_paged_kernel(cfg: TransformerConfig, page_size: int,
     where the paged == contiguous exactness pin runs. Either choice
     can be forced with "kernel"/"gather"; cfg is a static jit argument,
     so changing the choice retraces rather than silently reusing a
-    cached program."""
+    cached program. Multi-process (slice) pools never auto-pick the
+    kernel: it has no partitioning rule, so tracing it over a sharded
+    pool would poison the first decode step on a real slice —
+    SlicePagedKVCache additionally pins its cfg to "gather" so even a
+    forced "kernel" cannot reach a sharded trace."""
     if cfg.paged_attention == "kernel":
         return True
     if cfg.paged_attention == "gather":
         return False
     return (jax.default_backend() == "tpu"
+            and jax.process_count() == 1
             and cfg.max_seq >= _PAGED_KERNEL_AUTO_MIN_SEQ
             and page_size >= _PAGED_KERNEL_AUTO_MIN_PAGE
             and width % 128 == 0)
@@ -551,8 +556,10 @@ class PagedKVCache:
         ``tokens`` is [slots] int32 (each active slot's pending token).
         Returns generated tokens [n_steps, slots]; row ``i`` is the
         token produced by feeding row ``i-1`` (row 0 fed ``tokens``).
-        Greedy only — sampled slots need the per-step path (their key
-        schedule folds a host-side step index).
+        Greedy only — mixed batches with sampled slots use
+        :meth:`step_window_sampled`, whose scan carries the sampled
+        rows' key schedule on device (base indices are host-known at
+        dispatch).
         """
         slots = self._step_slots(active)
         grew = False
@@ -779,12 +786,18 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     # whole scale arrays fit the kernel's VMEM budget. "auto" routes
     # oversized pools to the gather; a FORCED kernel that cannot run
     # refuses loudly (PagedKVCache.__init__ rejects it up front; this
-    # trace-time raise is the defense for direct kernel callers).
+    # trace-time raise is the defense for direct kernel callers). Only
+    # traces the kernel could actually take refuse: prefill and spec-
+    # verify (slot set / q_len > 1) always run the gather, so raising
+    # there would kill legitimate programs a forced-kernel pool still
+    # needs.
+    kernel_eligible = slot is None and q_len == 1
     if quantized:
         from kvedge_tpu.ops.paged_attention import scales_fit_vmem
 
         scales_fit = scales_fit_vmem(new_scale_k.size)
-        if cfg.paged_attention == "kernel" and not scales_fit:
+        if (kernel_eligible and cfg.paged_attention == "kernel"
+                and not scales_fit):
             raise ValueError(
                 "paged_attention='kernel' forced but the int8 scale "
                 f"arrays ({new_scale_k.size} fp32 elements x2) exceed "
@@ -793,7 +806,7 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
             )
     else:
         scales_fit = True
-    if (slot is None and q_len == 1 and scales_fit
+    if (kernel_eligible and scales_fit
             and _use_paged_kernel(cfg, pool_k_l.shape[1], kv * dh)):
         # Single-query decode (steps and windows): attention directly
         # over the block table — K/V pages stream up to each row's LIVE

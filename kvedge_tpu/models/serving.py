@@ -31,6 +31,12 @@ import time
 
 import numpy as np
 
+from kvedge_tpu.runtime.failures import (
+    PoolPoisoned,
+    ServingFailure,
+    classify_failure,
+)
+
 # Stream sentinel objects (token queue carries ints, then one of these).
 _STREAM_DONE = object()
 
@@ -273,6 +279,22 @@ class PagedGenerationServer:
         self._free_slots = list(range(slots))[::-1]
         self._closed = False
         self._draining = False
+        # Degraded mode (runtime/failures.py): a decode-loop failure
+        # poisons the pool — in-flight waiters get the typed failure,
+        # new submits are refused with a retry-after hint, and the
+        # reason is exposed lock-free so /healthz can flip to 503
+        # without touching the server lock.
+        self._poison: ServingFailure | None = None
+        self._degraded_reason: str | None = None
+        # Optional observer (set by the workload layer): called once,
+        # outside the lock, when the pool poisons — e.g. to persist a
+        # post-mortem failure record in the state dir.
+        self.on_degraded = None
+        # Recorded by start_prefix_persistence so a poisoned-but-
+        # readable pool can emergency-dump its warm prefixes on the
+        # way down.
+        self._persist_path: str | None = None
+        self._persist_fp: str | None = None
         # Admissions whose chunked prefill is in flight (slot granted,
         # not yet in _active): the decode loop must not exit — and a
         # drain must not report done — while any exist, or their
@@ -331,6 +353,23 @@ class PagedGenerationServer:
             req.cancelled = True
             self._work.notify_all()
 
+    def _refusal(self) -> Exception:
+        """The typed refusal a new/interrupted request gets (lock
+        held): a poisoned pool beats plain ServerClosed — the client
+        learns it may retry (against the rescheduled pod) and how long
+        to wait, instead of a terminal-looking shutdown error."""
+        if self._poison is not None:
+            e = PoolPoisoned(
+                f"serving pool is poisoned ({self._degraded_reason}); "
+                f"retry against the rescheduled pod"
+            )
+            e.__cause__ = self._poison
+            return e
+        return ServerClosed(
+            "server is draining" if self._draining
+            else "server is shut down"
+        )
+
     def _start(self, prompt: list[int], n_new: int, timeout: float,
                sampling: tuple | None, stream: bool) -> _Request:
         if not prompt or n_new < 1:
@@ -377,10 +416,7 @@ class PagedGenerationServer:
                     )
                 self._work.wait(timeout=remaining)
             if self._closed or self._draining:
-                raise ServerClosed(
-                    "server is draining" if self._draining
-                    else "server is shut down"
-                )
+                raise self._refusal()
             slot = self._free_slots.pop()
             self._reserved += pages_needed
             # Prefix sharing: start the table on the cached prefix's
@@ -417,7 +453,7 @@ class PagedGenerationServer:
                 piece = req.prompt[off:off + chunk]
                 with self._work:
                     if self._closed:
-                        raise ServerClosed("server shut down mid-prefill")
+                        raise self._refusal()
                     if req.cancelled:
                         raise RequestCancelled(
                             "request cancelled during prefill"
@@ -432,7 +468,7 @@ class PagedGenerationServer:
                 # land between the last chunk and here, after which no
                 # loop is alive to serve (or poison) this request.
                 if self._closed:
-                    raise ServerClosed("server shut down mid-prefill")
+                    raise self._refusal()
                 req.next_token = req.pick(logits, 0)
                 self._active[slot] = req
                 self._prefilling -= 1
@@ -443,13 +479,40 @@ class PagedGenerationServer:
                     req.prompt, self._cache.slot_pages(slot)
                 )
                 self._work.notify_all()  # wake the decode loop
-        except Exception:
+        except Exception as e:
             with self._work:
                 if not activated:
                     self._prefilling -= 1
                     self._release_locked(slot, pages_needed)
+                if (isinstance(e, ServingFailure)
+                        and not e.retryable):
+                    # A terminal failure on the SUBMIT path (the op
+                    # watchdog can fire during this request's prefill,
+                    # not just in the decode loop) kills the pool for
+                    # everyone: poison co-tenants now with the typed
+                    # error rather than letting them ride a dead cache
+                    # into the same failure one window later.
+                    self._poison_locked(e)
             raise
         return req
+
+    def _poison_locked(self, failure: ServingFailure) -> None:
+        """Poison the pool (lock held): every in-flight waiter gets the
+        typed failure, the degraded flag flips for stats/healthz, and
+        admission waiters wake to fail fast with _refusal()'s
+        retry-after hint. The exiting decode loop runs _degrade() for
+        the outside-the-lock cleanup (emergency dump, observer)."""
+        if self._poison is None:
+            self._poison = failure
+            self._degraded_reason = f"{type(failure).__name__}: {failure}"
+        for req in self._active.values():
+            req.error = failure
+            if req.stream is not None:
+                req.stream.put(failure)
+            req.done.set()
+        self._active.clear()
+        self._closed = True
+        self._work.notify_all()
 
     # ---- prefix sharing (lock held for every method here) ----------------
 
@@ -721,6 +784,9 @@ class PagedGenerationServer:
         if self._persist_stop is not None:
             raise RuntimeError("prefix persistence already started")
         self._persist_stop = threading.Event()
+        # Remembered for the degraded path: a poisoned-but-readable
+        # pool emergency-dumps to the same file on its way down.
+        self._persist_path, self._persist_fp = path, fingerprint
 
         def loop() -> None:
             dumped_at = 0
@@ -909,18 +975,56 @@ class PagedGenerationServer:
         # AFTER any in-flight request thread's cache call (a hard close
         # can race a chunked prefill whose error path still releases its
         # slot) and the cache's idempotence flag is check-then-act
-        # atomic. Single-host caches define no stop. A decode thread
-        # that outlived its join timeout may be wedged in a collective
-        # HOLDING the lock (dead follower) — skip the release rather
-        # than hang close() too; that slice is already lost.
+        # atomic. Single-host caches define no stop. Slice ops are
+        # deadline-bounded now (runtime/failures.py), so a dead
+        # follower poisons the loop with SliceFollowerLost instead of
+        # wedging it — the liveness guard below is the backstop for a
+        # step wedged OUTSIDE the watchdog (single-host device hang):
+        # skip the release rather than hang close() too. stop() itself
+        # is also deadline-bounded, so close() stays bounded even when
+        # the followers die between the last op and the STOP broadcast.
         stop = getattr(self._cache, "stop", None)
         if stop is not None and not self._thread.is_alive():
             with self._work:
                 stop()
 
+    @property
+    def degraded(self) -> str | None:
+        """The degraded-mode reason, or None while healthy. Lock-free
+        on purpose: /healthz reads this and must answer even if some
+        thread is misbehaving around the server lock."""
+        return self._degraded_reason
+
+    def _degrade(self) -> None:
+        """Best-effort degraded-mode work, run once by the exiting
+        decode loop, OUTSIDE the lock: emergency-dump the prefix cache
+        if the pool is still readable (a follower-lost slice cache
+        refuses persistence and a dead op stream would wedge — both
+        surface as an exception and the dump is skipped; a single-host
+        pool poisoned by a host-side bug is usually intact), then
+        notify the workload observer."""
+        if self._persist_path is not None and self._prefix_entry_nodes:
+            try:
+                n = self.dump_prefix_cache(
+                    self._persist_path, self._persist_fp
+                )
+                print(f"[kvedge-serve] degraded: emergency prefix dump "
+                      f"wrote {n} entries", flush=True)
+            except Exception as e:
+                print(f"[kvedge-serve] degraded: emergency prefix dump "
+                      f"skipped ({e!r})", flush=True)
+        cb = self.on_degraded
+        if cb is not None:
+            try:
+                cb(self._degraded_reason, self._poison)
+            except Exception as e:  # observers never re-poison teardown
+                print(f"[kvedge-serve] on_degraded observer failed: "
+                      f"{e!r}", flush=True)
+
     def stats(self) -> dict:
         with self._lock:
             out = {
+                "degraded": 1 if self._degraded_reason else 0,
                 "in_flight": len(self._active),
                 "free_slots": len(self._free_slots),
                 "free_pages": self._cache.free_pages(),
@@ -932,6 +1036,8 @@ class PagedGenerationServer:
                 "prefix_hits": self._prefix_hits,
                 "prefix_tokens_saved": self._prefix_tokens_saved,
             }
+            if self._degraded_reason:
+                out["degraded_reason"] = self._degraded_reason
             if self._spec:
                 # Realized acceleration PER GREEDY SLOT: mean tokens a
                 # greedy slot emits per verify pass it participates in
@@ -1167,6 +1273,8 @@ class PagedGenerationServer:
     def _loop(self) -> None:
         while True:
             if self._loop_once() == "exit":
+                if self._poison is not None:
+                    self._degrade()  # outside the lock, loop exited
                 return
             # Fair handoff: the loop would otherwise reacquire the lock
             # immediately, and under CPython's GIL an admission waiter
@@ -1295,15 +1403,12 @@ class PagedGenerationServer:
                     self._emit(req, req.next_token)
                     req.next_token = next_tokens[slot]
             except Exception as e:  # poison: fail every waiter loudly
-                for req in self._active.values():
-                    req.error = e
-                    if req.stream is not None:
-                        req.stream.put(e)
-                    req.done.set()
-                self._active.clear()
-                self._closed = True
-                # Wake admission waiters so they fail fast with
-                # ServerClosed instead of sleeping out their timeout.
-                self._work.notify_all()
+                # Typed poisoning (runtime/failures.py): an already-
+                # typed failure (e.g. SliceFollowerLost from the op
+                # watchdog) passes through; anything else is wrapped as
+                # PoolPoisoned with the cause chained. Waiters get the
+                # typed error, new submits get _refusal()'s retry-after
+                # hint, and the degraded flag flips for stats/healthz.
+                self._poison_locked(classify_failure(e))
                 return "exit"
         return "ran"

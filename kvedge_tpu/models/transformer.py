@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from kvedge_tpu.compat import shard_map
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -616,7 +618,7 @@ def _fused_xent_loss(params: dict, inputs, targets,
 
         # check_vma off: pallas_call out_shapes don't declare mesh-axis
         # variance, which the checker would otherwise require.
-        per_row = jax.shard_map(
+        per_row = shard_map(
             lambda x, e, tg: fused_xent(x, e, tg, interpret),
             mesh=mesh,
             in_specs=(P("data", None), P(), P("data")),

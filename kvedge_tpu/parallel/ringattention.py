@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from kvedge_tpu.compat import shard_map
+
 # Finite stand-in for -inf: keeps fully-masked rows NaN-free in the online
 # softmax (exp(-BIG - m) == 0 exactly in fp32) without special-casing.
 _MASKED = -1e30
@@ -144,7 +146,7 @@ def ring_attention(q, k, v, mesh, *, seq_axis: str = "seq",
     local = functools.partial(
         _ring_attention_local, axis_name=seq_axis, sp=sp
     )
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 

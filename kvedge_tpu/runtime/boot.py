@@ -75,6 +75,12 @@ class RuntimeHandle:
                 if getattr(self.serve_fn, "stats", None) is not None
                 else None
             ),
+            # Post-mortem of the last serving failure, persisted on the
+            # state volume (runtime/heartbeat.py) — survives rescheduling
+            # so the replacement pod reports why its predecessor died.
+            "last_failure": heartbeat.read_failure_record(
+                self.cfg.state_dir
+            ),
         }
 
     def shutdown(self) -> None:
@@ -226,13 +232,32 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
             )
         return handle.serve_fn(doc)
 
+    def serve_degraded() -> str | None:
+        # Lock-free by contract (workload.py attaches a plain attribute
+        # read): /healthz is hit by liveness probes every few seconds
+        # and must never queue behind the serving work lock.
+        fn = getattr(handle.serve_fn, "degraded", None)
+        return fn() if fn is not None else None
+
+    def health_detail() -> dict | None:
+        # Enriches an unhealthy /healthz body. A poisoned serving pool
+        # is terminal — it never recovers in place, only by rescheduling
+        # — so probes (healthcheck.wait_healthy) stop polling early.
+        reason = serve_degraded()
+        if reason is not None:
+            return {"reason": reason, "terminal": True}
+        if not handle.check.ok and handle.check.error:
+            return {"reason": handle.check.error}
+        return None
+
     server = StatusServer(
         cfg.status_bind, cfg.status_port,
         snapshot=lambda: handle.snapshot(),
-        healthy=lambda: handle.check.ok,
+        healthy=lambda: handle.check.ok and serve_degraded() is None,
         profiler=profile,
         token=cfg.status_token,
         generator=generate,
+        health_detail=health_detail,
     )
     handle = RuntimeHandle(
         cfg=cfg, check=_booting(), writer=writer, server=server,

@@ -19,6 +19,83 @@ from typing import Any
 CHECKPOINT_SUBDIR = "checkpoints"
 
 
+def _shape_index(tree: Any) -> dict[str, tuple]:
+    """``{"params/embedding": (512, 128), ...}`` for every leaf with a
+    shape. Key-path strings normalize container differences — orbax
+    metadata renders optax's namedtuples/tuples as dicts of stringified
+    indices, so treedef equality is the wrong comparator across that
+    boundary; names are stable."""
+    import jax
+
+    idx: dict[str, tuple] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        parts = []
+        for p in path:
+            part = getattr(p, "key", None)
+            if part is None:
+                part = getattr(p, "name", None)
+            if part is None:
+                part = getattr(p, "idx", None)
+            parts.append(str(part))
+        idx["/".join(parts)] = tuple(shape)
+    return idx
+
+
+def _verify_template(abstract_tree: Any, saved_tree: Any, source: str,
+                     *, structure_must_match: bool = True) -> None:
+    """Raise loudly when the restore template doesn't match the
+    checkpoint.
+
+    The mismatch-fails-loudly contract (a serve pod whose [model]
+    disagrees with the checkpoint must error, never silently decode a
+    different architecture) must not depend on the orbax version doing
+    the checking: some releases fulfil a mismatched template from
+    whatever the file holds without erroring. ``saved_tree`` is the
+    checkpoint's own metadata (pre-restore) or the restored tree
+    (post-restore net). Shape checks skip template leaves without a
+    ``.shape`` (e.g. PLACEHOLDER markers on partial restores).
+    """
+    import jax
+
+    want, want_def = jax.tree_util.tree_flatten(abstract_tree)
+    got, got_def = jax.tree_util.tree_flatten(saved_tree)
+    if want_def != got_def:
+        if not structure_must_match:
+            # Metadata pre-check: container types differ legitimately
+            # (orbax metadata renders tuples as dicts), so match leaves
+            # by key path instead of treedef.
+            want_idx = _shape_index(abstract_tree)
+            got_idx = _shape_index(saved_tree)
+            for key in want_idx.keys() & got_idx.keys():
+                if want_idx[key] != got_idx[key]:
+                    raise ValueError(
+                        f"checkpoint shape mismatch against the "
+                        f"{source} at {key!r}: template expects "
+                        f"{want_idx[key]}, checkpoint holds "
+                        f"{got_idx[key]} — the configured model does "
+                        "not match the checkpointed one"
+                    )
+            return
+        raise ValueError(
+            "checkpoint tree structure mismatch against the "
+            f"{source}: the restore template has {want_def}, the "
+            f"checkpoint holds {got_def} — the configured model does "
+            "not match the checkpointed one"
+        )
+    for w, g in zip(want, got):
+        ws, gs = getattr(w, "shape", None), getattr(g, "shape", None)
+        if ws is not None and gs is not None and tuple(ws) != tuple(gs):
+            raise ValueError(
+                f"checkpoint shape mismatch against the {source}: "
+                f"template expects {tuple(ws)}, checkpoint holds "
+                f"{tuple(gs)} — the configured model does not match "
+                "the checkpointed one"
+            )
+
+
 def resolve_checkpoint_dir(state_dir: str, checkpoint_dir: str = "") -> str:
     """Where checkpoints live for a given state volume + optional override.
 
@@ -71,6 +148,33 @@ class StateCheckpointer:
     def latest_step(self) -> int | None:
         return self._manager.latest_step()
 
+    def _saved_metadata(self, step: int) -> Any | None:
+        """Shape metadata of the saved tree, or None when unreadable.
+
+        ``item_metadata`` resolves through the manager's handler
+        registry, which a manager that never saved may not have bound
+        yet (it then yields an empty tree); the handler-level
+        ``metadata()`` reads the step directory directly. Best-effort:
+        any failure returns None and the post-restore net still runs.
+        """
+        import jax
+
+        try:
+            meta = self._manager.item_metadata(step)
+            if meta is not None and jax.tree_util.tree_leaves(meta):
+                return meta
+        except Exception:
+            pass
+        try:
+            from etils import epath
+
+            path = epath.Path(self._dir) / str(step) / "default"
+            if path.exists():
+                return self._ocp.StandardCheckpointHandler().metadata(path)
+        except Exception:
+            pass
+        return None
+
     def restore_latest(self, abstract_tree: Any = None, *,
                        partial: bool = False) -> tuple[int, Any] | None:
         """(step, tree) of the newest checkpoint, or None on a fresh volume.
@@ -89,11 +193,28 @@ class StateCheckpointer:
         if step is None:
             return None
         if abstract_tree is not None:
+            saved = self._saved_metadata(step)
+            if saved is not None:
+                _verify_template(abstract_tree, saved,
+                                 "checkpoint metadata",
+                                 structure_must_match=False)
+        if abstract_tree is not None:
             args = (self._ocp.args.PyTreeRestore(abstract_tree) if partial
                     else self._ocp.args.StandardRestore(abstract_tree))
             tree = self._manager.restore(step, args=args)
         else:
-            tree = self._manager.restore(step)
+            try:
+                tree = self._manager.restore(step)
+            except KeyError:
+                # Some orbax versions refuse an argless restore on a
+                # manager that never saved (no handler bound for the
+                # item yet); StandardRestore with topology inference is
+                # the same operation spelled explicitly.
+                tree = self._manager.restore(
+                    step, args=self._ocp.args.StandardRestore()
+                )
+        if abstract_tree is not None:
+            _verify_template(abstract_tree, tree, "restored tree")
         return step, tree
 
     def close(self) -> None:

@@ -10,6 +10,11 @@ because ``helm test`` is typically run right after install, while the
 runtime may still be compiling its first payload or waiting for
 multi-host peers — the status server serves 503 until boot completes.
 
+One 503 is *not* worth polling out: a poisoned serving pool
+(runtime/failures.py) marks its /healthz body ``"terminal": true``
+because it only recovers by rescheduling — the probe fails fast so the
+operator (or CI) learns in seconds, not after the full deadline.
+
 Usable standalone against any deployment:
 
     python -m kvedge_tpu.runtime.healthcheck http://<ip>:8476/healthz
@@ -18,6 +23,7 @@ Usable standalone against any deployment:
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 import urllib.error
@@ -26,7 +32,13 @@ import urllib.request
 
 def wait_healthy(url: str, deadline_s: float = 240.0,
                  interval_s: float = 5.0) -> tuple[bool, str]:
-    """Poll ``url`` until HTTP 200 or deadline. Returns (ok, last_detail)."""
+    """Poll ``url`` until HTTP 200 or deadline. Returns (ok, last_detail).
+
+    A 503 whose JSON body carries ``"terminal": true`` (a poisoned
+    serving pool — boot.py's health_detail) returns failure immediately:
+    that state never clears without a reschedule, so continuing to poll
+    would only delay the verdict.
+    """
     deadline = time.monotonic() + deadline_s
     detail = "no attempt made"
     while True:
@@ -34,8 +46,16 @@ def wait_healthy(url: str, deadline_s: float = 240.0,
             with urllib.request.urlopen(url, timeout=10) as resp:
                 return True, f"HTTP {resp.status}"
         except urllib.error.HTTPError as e:
-            # 503 = runtime up but degraded/booting; keep polling.
-            detail = f"HTTP {e.code}: {e.read().decode(errors='replace')!r}"
+            # 503 = runtime up but degraded/booting; keep polling unless
+            # the body says the degradation is terminal.
+            body = e.read().decode(errors="replace")
+            detail = f"HTTP {e.code}: {body!r}"
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                doc = {}
+            if isinstance(doc, dict) and doc.get("terminal"):
+                return False, detail
         except Exception as e:  # DNS not yet registered, conn refused, ...
             detail = f"{type(e).__name__}: {e}"
         if time.monotonic() >= deadline:

@@ -98,6 +98,36 @@ def read_train_progress(state_dir: str) -> dict | None:
     return _read_json_doc(os.path.join(state_dir, TRAIN_PROGRESS_FILE))
 
 
+# Post-mortem record of the last serving-path failure (typed taxonomy,
+# runtime/failures.py): written when the serving pool degrades, read
+# back into /status by boot.snapshot(). On the PVC so the REPLACEMENT
+# pod — the whole point of degrading is to be rescheduled — can report
+# why its predecessor died, the same boot_count-style continuity the
+# heartbeat itself proves.
+FAILURE_FILE = "last-failure.json"
+
+
+def write_failure_record(state_dir: str, doc: dict) -> dict:
+    """Atomically persist a failure record, stamped with ts and the
+    current boot_count (the generation that failed)."""
+    os.makedirs(state_dir, exist_ok=True)
+    record = dict(doc)
+    record["ts"] = time.time()
+    record["boot_count"] = int(
+        (read_heartbeat(state_dir) or {}).get("boot_count", 0)
+    )
+    _write_json_atomic(
+        os.path.join(state_dir, FAILURE_FILE), record,
+        indent=2, sort_keys=True,
+    )
+    return record
+
+
+def read_failure_record(state_dir: str) -> dict | None:
+    """The last persisted failure, or None (absent/corrupt/never failed)."""
+    return _read_json_doc(os.path.join(state_dir, FAILURE_FILE))
+
+
 def write_heartbeat(state_dir: str, payload: dict) -> dict:
     """Atomically write a heartbeat, advancing seq and preserving boot_count."""
     os.makedirs(state_dir, exist_ok=True)

@@ -27,11 +27,18 @@ Why this is sound:
   page boundaries the decode loop dispatches one WINDOW op per
   ``page_size`` greedy tokens; the cross-host control traffic rides the
   same cadence as the single-host loop's host reads.
-* **Failure is slice-fatal, by policy.** A follower that dies leaves
-  the leader blocked in a collective — the same contract as multi-host
-  training, and the chart's StatefulSet restarts the slice (SERVING.md
-  names rejoin-at-a-boundary as the alternative and why it isn't
-  worth the state-machine complexity at this scale).
+* **Failure is slice-fatal, but bounded.** A follower that dies used
+  to leave the leader blocked in a collective forever, holding the
+  server's work lock. Every leader-side op now runs through a
+  :class:`~kvedge_tpu.runtime.failures.DeadlineRunner` with
+  compile-aware budgets: a wedged op is orphaned on the op thread and
+  surfaces as a typed
+  :class:`~kvedge_tpu.runtime.failures.SliceFollowerLost`, the op
+  stream latches dead, and the serving layer degrades (poisons
+  in-flight requests, refuses new ones, keeps ``close()`` bounded)
+  while the chart's StatefulSet restarts the slice. Rejoin-at-a-
+  boundary remains rejected (SERVING.md) — detection is cheap, a
+  follower state machine is not.
 
 The reference has no serving and no multi-host anything (SURVEY.md §0,
 §5); this module is the last rung of the serving ladder this repo
@@ -40,8 +47,16 @@ climbs on top of the reference's deployment story.
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
+from kvedge_tpu.runtime.failures import (
+    DeadlineRunner,
+    DeviceOpTimeout,
+    OpBudgets,
+    SliceFollowerLost,
+)
 from kvedge_tpu.models.kvcache import (
     PagedCacheError,
     PagedKVCache,
@@ -134,9 +149,18 @@ class SlicePagedKVCache(PagedKVCache):
 
     def __init__(self, cfg, *, slots: int, pages: int, page_size: int,
                  mesh, max_pages_per_seq: int | None = None,
-                 kv_dtype: str = ""):
+                 kv_dtype: str = "", op_budgets: OpBudgets | None = None):
         import jax
 
+        # Slice pools always use the gather path: the Pallas kernel has
+        # no partitioning rule, so tracing it over a model-sharded pool
+        # would poison the first decode step on a real slice. Pinned
+        # here (every process constructs the same cfg, so the pin is
+        # part of the protocol) rather than left to _use_paged_kernel's
+        # per-trace heuristics — even an explicit "kernel" override is
+        # downgraded, and __init__'s forced-kernel VMEM refusal never
+        # fires spuriously for a slice cache.
+        cfg = dataclasses.replace(cfg, paged_attention="gather")
         self.mesh = mesh
         (self._rep, self._state_sh, self._k_prefill, self._k_step,
          self._k_window, self._k_spec,
@@ -145,6 +169,15 @@ class SlicePagedKVCache(PagedKVCache):
          )
         self._is_leader = jax.process_index() == 0
         self._stopped = False
+        # Leader-side watchdog over the op stream (header send,
+        # broadcast, exec): a wedged collective surfaces as a typed
+        # SliceFollowerLost instead of an eternal hang holding the
+        # server's work lock. Followers keep the raw slice-fatal
+        # contract — their recovery path is the pod dying.
+        self._ops = DeadlineRunner(
+            op_budgets, failure=SliceFollowerLost,
+            name="kvedge-slice-ops",
+        )
         super().__init__(
             cfg, slots=slots, pages=pages, page_size=page_size,
             max_pages_per_seq=max_pages_per_seq, kv_dtype=kv_dtype,
@@ -224,16 +257,21 @@ class SlicePagedKVCache(PagedKVCache):
     # ---- leader-side device seams (base-class host logic unchanged) -----
 
     def _sync(self) -> None:
-        if self._stopped:
+        if self._stopped or self._ops.dead is not None:
             # Teardown tail: a request thread unwinding after a hard
-            # close still releases its slot, which syncs tables — the
-            # followers are gone, the device state is dead, so the
-            # host bookkeeping proceeds without a broadcast.
+            # close (or after the op stream died) still releases its
+            # slot, which syncs tables — the followers are gone, the
+            # device state is dead, so the host bookkeeping proceeds
+            # without a broadcast.
             return
         tables = np.asarray(self._host_tables, np.int32)
         lengths = np.asarray(self._host_lengths, np.int32)
-        self._send_header(OP_SYNC)
-        tables, lengths = self._bcast((tables, lengths))
+
+        def op():
+            self._send_header(OP_SYNC)
+            return self._bcast((tables, lengths))
+
+        tables, lengths = self._ops.run(("sync",), op)
         self._apply_sync(np.asarray(tables), np.asarray(lengths))
 
     def _apply_sync(self, tables: np.ndarray, lengths: np.ndarray):
@@ -246,6 +284,12 @@ class SlicePagedKVCache(PagedKVCache):
         )
 
     def _check_live(self) -> None:
+        if self._ops.dead is not None:
+            raise SliceFollowerLost(
+                f"slice op stream is dead (op {self._ops.dead} timed "
+                f"out — follower lost); the slice must be rescheduled",
+                op=self._ops.dead,
+            )
         if self._stopped:
             raise PagedCacheError(
                 "slice serve is stopped — the followers were released"
@@ -254,9 +298,13 @@ class SlicePagedKVCache(PagedKVCache):
     def _device_prefill(self, params, tokens, slot: int, offset: int):
         self._check_live()
         tokens = np.asarray(tokens, np.int32)
-        self._send_header(OP_PREFILL, slot, offset, tokens.shape[0])
-        tokens = np.asarray(self._bcast(tokens))
-        return self._exec_prefill(params, tokens, slot, offset)
+
+        def op():
+            self._send_header(OP_PREFILL, slot, offset, tokens.shape[0])
+            sent = np.asarray(self._bcast(tokens))
+            return self._exec_prefill(params, sent, slot, offset)
+
+        return self._ops.run(("prefill", tokens.shape[0]), op)
 
     def _exec_prefill(self, params, tokens: np.ndarray, slot: int,
                       offset: int):
@@ -277,10 +325,15 @@ class SlicePagedKVCache(PagedKVCache):
     def _device_step(self, params, tokens, active):
         self._check_live()
         tokens = np.asarray(tokens, np.int32)
-        self._send_header(OP_STEP)
-        tokens, mask = self._bcast((tokens, self._active_np(active)))
-        return self._exec_step(params, np.asarray(tokens),
-                               np.asarray(mask))
+        mask = self._active_np(active)
+
+        def op():
+            self._send_header(OP_STEP)
+            sent, m = self._bcast((tokens, mask))
+            return self._exec_step(params, np.asarray(sent),
+                                   np.asarray(m))
+
+        return self._ops.run(("step",), op)
 
     def _exec_step(self, params, tokens: np.ndarray, mask: np.ndarray):
         logits, self.state = self._k_step(
@@ -292,10 +345,15 @@ class SlicePagedKVCache(PagedKVCache):
     def _device_window(self, params, tokens, n_steps: int, active):
         self._check_live()
         tokens = np.asarray(tokens, np.int32)
-        self._send_header(OP_WINDOW, n_steps)
-        tokens, mask = self._bcast((tokens, self._active_np(active)))
-        return self._exec_window(params, np.asarray(tokens),
-                                 np.asarray(mask), n_steps)
+        mask = self._active_np(active)
+
+        def op():
+            self._send_header(OP_WINDOW, n_steps)
+            sent, m = self._bcast((tokens, mask))
+            return self._exec_window(params, np.asarray(sent),
+                                     np.asarray(m), n_steps)
+
+        return self._ops.run(("window", n_steps), op)
 
     def _exec_window(self, params, tokens: np.ndarray, mask: np.ndarray,
                      n_steps: int):
@@ -311,17 +369,23 @@ class SlicePagedKVCache(PagedKVCache):
         self._check_live()
         tokens = np.asarray(tokens, np.int32)
         key_data = np.asarray(key_data, np.uint32)
-        self._send_header(OP_WSAMPLE, n_steps, key_data.shape[1])
-        payload = self._bcast((
-            tokens, self._active_np(active), key_data,
-            np.asarray(base_steps, np.int32),
-            np.asarray(temps, np.float32),
-            np.asarray(top_ps, np.float32),
-            np.asarray(sampled_mask, bool),
-        ))
-        return self._exec_window_sampled(
-            params, *(np.asarray(x) for x in payload), n_steps=n_steps
-        )
+        mask = self._active_np(active)
+
+        def op():
+            self._send_header(OP_WSAMPLE, n_steps, key_data.shape[1])
+            payload = self._bcast((
+                tokens, mask, key_data,
+                np.asarray(base_steps, np.int32),
+                np.asarray(temps, np.float32),
+                np.asarray(top_ps, np.float32),
+                np.asarray(sampled_mask, bool),
+            ))
+            return self._exec_window_sampled(
+                params, *(np.asarray(x) for x in payload),
+                n_steps=n_steps,
+            )
+
+        return self._ops.run(("wsample", n_steps), op)
 
     def _exec_window_sampled(self, params, tokens, mask, key_data,
                              base_steps, temps, top_ps, smask, *,
@@ -340,13 +404,17 @@ class SlicePagedKVCache(PagedKVCache):
     def _device_spec(self, params, tokens, active, spec_mask):
         self._check_live()
         tokens = np.asarray(tokens, np.int32)
-        self._send_header(OP_SPEC, tokens.shape[1] - 1)
-        tokens, mask, smask = self._bcast(
-            (tokens, self._active_np(active),
-             np.asarray(spec_mask, bool))
-        )
-        return self._exec_spec(params, np.asarray(tokens),
-                               np.asarray(mask), np.asarray(smask))
+        mask = self._active_np(active)
+
+        def op():
+            self._send_header(OP_SPEC, tokens.shape[1] - 1)
+            sent, m, smask = self._bcast(
+                (tokens, mask, np.asarray(spec_mask, bool))
+            )
+            return self._exec_spec(params, np.asarray(sent),
+                                   np.asarray(m), np.asarray(smask))
+
+        return self._ops.run(("spec", tokens.shape[1]), op)
 
     def _exec_spec(self, params, tokens: np.ndarray, mask: np.ndarray,
                    spec_mask: np.ndarray):
@@ -366,11 +434,25 @@ class SlicePagedKVCache(PagedKVCache):
         flag check atomic; a second STOP would be a collective the
         departed followers never join. After stop, table syncs become
         local no-ops (teardown still releases slots) and device ops
-        refuse loudly."""
+        refuse loudly.
+
+        Deadline-bounded like every other op: if the followers are
+        already dead the STOP broadcast would wedge ``close()`` — the
+        stream is skipped when it has latched dead, and a fresh wedge
+        here is swallowed after its budget (close() must return; the
+        followers it failed to release are lost either way)."""
         if self._stopped:
             return
         self._stopped = True
-        self._send_header(OP_STOP)
+        if self._ops.dead is not None:
+            return  # stream already wedged; nothing left to release
+        try:
+            # STOP is a bare header — no compilation — so it gets the
+            # steady budget even as a first use.
+            self._ops.run(("stop",), lambda: self._send_header(OP_STOP),
+                          budget_s=self._ops.steady_s)
+        except DeviceOpTimeout:
+            pass
 
     # ---- follower side ---------------------------------------------------
 

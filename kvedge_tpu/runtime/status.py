@@ -101,6 +101,12 @@ _SERVE_METRIC_FIELDS = (
     ("spec_emitted_per_pass", "serve_spec_emitted_per_pass", "gauge",
      "mean greedy tokens emitted per verify pass — the realized "
      "speculative acceleration (paged backend)"),
+    # Failure surface (runtime/failures.py): 1 once the pool has been
+    # poisoned by a terminal serving failure — the alert-on signal that
+    # this pod needs rescheduling, not retrying.
+    ("degraded", "serve_degraded", "gauge",
+     "1 if the serving pool is poisoned/degraded (terminal failure; "
+     "the pod should be rescheduled)"),
 )
 
 
@@ -164,20 +170,26 @@ class StatusServer:
 
     ``snapshot`` supplies the /status document; ``healthy`` is a cheap
     in-memory check for /healthz (liveness probes hit it every few seconds,
-    so it must not touch the state volume). A non-empty ``token`` gates
-    every mutating (POST) route behind ``Authorization: Bearer <token>``;
-    the read-only GET surface is never gated.
+    so it must not touch the state volume). ``health_detail``, also cheap
+    and in-memory, enriches an unhealthy /healthz body — a degraded
+    serving pool adds its failure reason and ``"terminal": true`` so
+    probes (runtime/healthcheck.py) can stop polling a pod that will
+    never recover in place. A non-empty ``token`` gates every mutating
+    (POST) route behind ``Authorization: Bearer <token>``; the read-only
+    GET surface is never gated.
     """
 
     def __init__(self, bind: str, port: int, snapshot: Callable[[], dict],
                  healthy: Callable[[], bool] | None = None,
                  profiler: Callable[[float], dict] | None = None,
                  token: str = "",
-                 generator: Callable[[dict], dict] | None = None):
+                 generator: Callable[[dict], dict] | None = None,
+                 health_detail: Callable[[], dict | None] | None = None):
         outer = self
         self._healthy = healthy or (
             lambda: bool(snapshot().get("ok", False))
         )
+        self._health_detail = health_detail
         self._profiler = profiler
         self._token = token
         self._generator = generator
@@ -210,8 +222,13 @@ class StatusServer:
                     )
                 elif self.path == "/healthz":
                     healthy = outer._healthy()
-                    self._send(200 if healthy else 503,
-                               {"status": "ok" if healthy else "degraded"})
+                    doc = {"status": "ok" if healthy else "degraded"}
+                    if not healthy and outer._health_detail is not None:
+                        try:
+                            doc.update(outer._health_detail() or {})
+                        except Exception:
+                            pass  # detail is best-effort; 503 already says it
+                    self._send(200 if healthy else 503, doc)
                 elif self.path == "/status":
                     self._send(200, outer._snapshot())
                 elif self.path == "/version":

@@ -481,13 +481,19 @@ def _restore_latest_params(cfg: RuntimeConfig, tcfg, mesh=None):
     abstract = jax.eval_shape(fresh_state)
     if mesh is not None:
         abstract = abstract_shard_tree(mesh, abstract)
-    abstract["opt_state"] = jax.tree_util.tree_map(
-        lambda _: ocp.PLACEHOLDER, abstract["opt_state"]
-    )
+    # Older orbax has no PLACEHOLDER: fall back to restoring the full
+    # tree and dropping the moments afterwards — correct either way, the
+    # skip is purely a memory optimisation.
+    placeholder = getattr(ocp, "PLACEHOLDER", None)
+    partial = placeholder is not None
+    if partial:
+        abstract["opt_state"] = jax.tree_util.tree_map(
+            lambda _: placeholder, abstract["opt_state"]
+        )
     with StateCheckpointer(
         cfg.state_dir, checkpoint_dir=cfg.checkpoint_dir
     ) as ckpt:
-        restored = ckpt.restore_latest(abstract, partial=True)
+        restored = ckpt.restore_latest(abstract, partial=partial)
     if restored is not None:
         step, tree = restored
         return step, tree["params"]
@@ -595,9 +601,10 @@ class _ServeCounters:
     """Request accounting shared by the single-host serve path and the
     multi-host leader — ONE definition of the ``kvedge_serve_*`` counter
     vocabulary and of the exception -> outcome-bucket mapping
-    (ValueError -> rejected/400, GenerateUnavailable -> unavailable/503,
-    anything else -> errors/500), so the two paths can never drift on
-    the /metrics contract."""
+    (ValueError -> rejected/400, GenerateUnavailable and retryable
+    ServingFailures -> unavailable/503, anything else — including
+    terminal ServingFailures like SliceFollowerLost -> errors/500), so
+    the two paths can never drift on the /metrics contract."""
 
     def __init__(self):
         import threading
@@ -619,9 +626,16 @@ class _ServeCounters:
             self.data[key] += n
 
     def count_outcome(self, exc: Exception) -> None:
+        from kvedge_tpu.runtime.failures import ServingFailure
         from kvedge_tpu.runtime.status import GenerateUnavailable
 
         if isinstance(exc, GenerateUnavailable):
+            self.count("unavailable_total")
+        elif isinstance(exc, ServingFailure) and exc.retryable:
+            # e.g. PoolPoisoned reaching a streamed request mid-flight
+            # (the non-streamed path maps it to GenerateUnavailable
+            # before it gets here): the client may retry after the
+            # reschedule, so it is unavailability, not a server error.
             self.count("unavailable_total")
         elif isinstance(exc, ValueError):
             self.count("rejected_total")
@@ -1206,6 +1220,28 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 kv_dtype=cfg.serving_kv_dtype,
                 cache=cache,
             )
+            # Degraded-mode observability: when the pool poisons
+            # (runtime/failures.py), persist a post-mortem failure
+            # record on the state volume — it survives the reschedule
+            # the degradation asks for, boot.snapshot() surfaces it
+            # under "last_failure", and the NEXT pod generation's
+            # /status shows why its predecessor died.
+            if cfg.state_dir:
+                from kvedge_tpu.runtime import heartbeat as hb_mod
+
+                state_dir = cfg.state_dir
+
+                def _record_failure(reason, failure):
+                    hb_mod.write_failure_record(state_dir, {
+                        "payload": "serve",
+                        "backend": backend or "paged",
+                        "type": type(failure).__name__,
+                        "reason": reason,
+                        "retryable": bool(getattr(failure, "retryable",
+                                                  False)),
+                    })
+
+                paged_server.on_degraded = _record_failure
             # Spec-mode economics probe (VERDICT r4 #7): measure this
             # session's verify-pass and window costs before traffic;
             # "auto" falls back to windowed decode when windows
@@ -1301,18 +1337,30 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                     ServerBusy,
                     ServerClosed,
                 )
+                from kvedge_tpu.runtime.failures import ServingFailure
                 from kvedge_tpu.runtime.status import GenerateUnavailable
+
+                def retriable(e: Exception) -> bool:
+                    """Conditions a client should retry — against this
+                    pod (busy/draining) or its replacement (poisoned
+                    pool): 503, not 500."""
+                    return (isinstance(e, (ServerBusy, ServerClosed))
+                            or (isinstance(e, ServingFailure)
+                                and e.retryable))
 
                 def fan_out_rows(n_rows: int, fn) -> None:
                     """Run ``fn(i)`` per row on the shared bounded pool
                     (rows must submit together to ride the same batched
                     decode step; excess rows queue behind the pool's
                     2 x slots workers), then apply the ONE
-                    error-priority policy: real faults surface first
-                    (HTTP 500), capacity conditions become
-                    GenerateUnavailable (503). Shared by the streamed
-                    and non-streamed paths so the two can never map the
-                    same server condition to different statuses."""
+                    error-priority policy: real faults — including
+                    terminal ServingFailures like SliceFollowerLost —
+                    surface first (HTTP 500), retriable conditions
+                    become GenerateUnavailable (503, with the failure's
+                    retry-after hint when it carries one). Shared by
+                    the streamed and non-streamed paths so the two can
+                    never map the same server condition to different
+                    statuses."""
                     errors: list = [None] * n_rows
 
                     def guarded(i):
@@ -1327,13 +1375,17 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                     for f in futures:
                         f.result()
                     for e in errors:
-                        if e is not None and not isinstance(
-                            e, (ServerBusy, ServerClosed)
-                        ):
+                        if e is not None and not retriable(e):
                             raise e
                     for e in errors:
-                        if isinstance(e, (ServerBusy, ServerClosed)):
-                            raise GenerateUnavailable(str(e)) from e
+                        if e is not None:
+                            retry_after = getattr(e, "retry_after_s",
+                                                  None)
+                            hint = ("" if retry_after is None else
+                                    f" (retry after ~{retry_after:g}s)")
+                            raise GenerateUnavailable(
+                                f"{e}{hint}"
+                            ) from e
 
                 if stream:
                     import queue as queue_mod
@@ -1543,6 +1595,13 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
             return out
 
         serve_fn.stats = serve_stats
+        # Lock-free degraded probe for /healthz (boot.py): reading
+        # stats() takes the server lock, which a health check must not
+        # depend on; the property is a bare attribute read.
+        serve_fn.degraded = (
+            (lambda: paged_server.degraded)
+            if paged_server is not None else (lambda: None)
+        )
 
         # Self-check: one tiny generation proves the restored params and
         # the decode path actually work before the endpoint goes live.

@@ -15,11 +15,27 @@ from kvedge_tpu.testing.faults import (
     FaultScheduleResult,
     InvariantViolation,
 )
+from kvedge_tpu.testing.servingfaults import (
+    FaultPlan,
+    FaultyCache,
+    FaultySliceTransport,
+    InjectedFault,
+    ServingFaultResult,
+    ServingFaultSchedule,
+    prefix_file_intact,
+)
 
 __all__ = [
     "FakeCluster",
     "FakeNode",
+    "FaultPlan",
     "FaultSchedule",
     "FaultScheduleResult",
+    "FaultyCache",
+    "FaultySliceTransport",
+    "InjectedFault",
     "InvariantViolation",
+    "ServingFaultResult",
+    "ServingFaultSchedule",
+    "prefix_file_intact",
 ]

@@ -119,3 +119,38 @@ def test_spec_and_prefill_paths_unaffected_by_kernel_flag(params):
         return (np.asarray(emitted).tolist(), np.asarray(accepted).tolist())
 
     assert spec_run(KERNEL_CFG) == spec_run(CFG)
+
+
+def test_auto_never_picks_kernel_multiprocess(monkeypatch):
+    """Slice pools must never auto-select the kernel: it has no
+    partitioning rule, so a sharded trace would poison the first decode
+    step on a real slice. All other auto conditions held true, the
+    process count alone must veto."""
+    import kvedge_tpu.models.kvcache as kvmod
+
+    cfg = dataclasses.replace(CFG, paged_attention="auto", max_seq=4096)
+    monkeypatch.setattr(kvmod.jax, "default_backend", lambda: "tpu")
+    assert kvmod._use_paged_kernel(cfg, 64, 256)
+    monkeypatch.setattr(kvmod.jax, "process_count", lambda: 2)
+    assert not kvmod._use_paged_kernel(cfg, 64, 256)
+
+
+def test_vmem_refusal_spares_gather_only_traces(params, monkeypatch):
+    """The trace-time VMEM refusal fires only where the kernel could
+    actually run (single-query decode). Prefill and spec-verify always
+    take the gather, so a forced-kernel int8 pool must still trace
+    them — refusing there would kill programs the pool needs."""
+    cfg = dataclasses.replace(CFG, paged_attention="kernel")
+    # Distinct pool geometry: reusing another test's shapes would hit
+    # the jit cache and skip the trace whose refusal is under test.
+    cache = PagedKVCache(cfg, slots=2, pages=20, page_size=4,
+                         kv_dtype="int8")
+    monkeypatch.setattr("kvedge_tpu.ops.paged_attention.scales_fit_vmem",
+                        lambda n: False)
+    cache.admit(0, 3)
+    cache.prefill(params, 0, jnp.asarray([5, 9, 2], jnp.int32))
+    tokens = np.zeros((2, 2), np.int32)
+    active = np.array([True, False])
+    cache.step_spec(params, tokens, active=active, spec_mask=active)
+    with pytest.raises(ValueError, match="VMEM budget"):
+        cache.step(params, jnp.asarray([1, 0], jnp.int32), active=active)

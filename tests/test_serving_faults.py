@@ -1,0 +1,324 @@
+"""Serving failure semantics under seeded fault injection.
+
+The contract under test is runtime/failures.py threaded through the
+whole serving path (SERVING.md "Failure semantics"): faults injected at
+the device seams — a follower's collective hanging, a broadcast stalled
+past its deadline, a device op raising mid-flight — must surface as
+TYPED errors, every in-flight request must terminate, the pool must
+degrade (refuse new work with a retry hint, flip the degraded flag),
+and close() must stay bounded. Schedules are deterministic per seed and
+replay exactly (testing/servingfaults.py).
+
+All fixed-seed and fast: these run in the tier-1 gate.
+"""
+
+import dataclasses
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kvedge_tpu.models import TransformerConfig, generate, init_params
+from kvedge_tpu.models.serving import PagedGenerationServer
+from kvedge_tpu.runtime.failures import (
+    DeviceOpTimeout,
+    OpBudgets,
+    PoolPoisoned,
+    ServingFailure,
+    SliceFollowerLost,
+)
+from kvedge_tpu.runtime.sliceserve import SlicePagedKVCache
+from kvedge_tpu.testing.servingfaults import (
+    FaultPlan,
+    FaultyCache,
+    FaultySliceTransport,
+    InjectedFault,
+    ServingFaultSchedule,
+    prefix_file_intact,
+)
+
+pytestmark = pytest.mark.fault
+
+CFG = TransformerConfig(
+    vocab=128, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2, d_ff=64,
+    max_seq=64,
+)
+
+# Tight budgets so a wedged op surfaces in seconds, with enough compile
+# headroom that a genuine first-trace on CPU never false-positives.
+BUDGETS = dict(steady_s=3.0, compile_s=20.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices()).reshape(2, 4)
+    return Mesh(devs, ("data", "model"))
+
+
+def reference(params, prompt, n_new):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), CFG,
+                   n_new=n_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _slice_server(params, mesh, plan):
+    cache = SlicePagedKVCache(
+        CFG, slots=3, pages=24, page_size=4, mesh=mesh,
+        op_budgets=OpBudgets(**BUDGETS),
+    )
+    FaultySliceTransport(cache, plan)
+    return PagedGenerationServer(params, CFG, cache=cache)
+
+
+# ---- the acceptance scenario: follower death mid-decode -----------------
+
+
+def test_follower_death_mid_decode_terminates_typed(params, mesh):
+    """A follower that stops answering mid-decode (its collective parks
+    forever) must not wedge anything: every in-flight request gets a
+    typed SliceFollowerLost, the pool degrades, and close() returns
+    promptly. fire_window starts past the admit-sync + prefill
+    broadcasts so the hang always lands in the decode phase."""
+    plan = FaultPlan(seed=7, kinds=("hang",), fire_window=(6, 7))
+    server = _slice_server(params, mesh, plan)
+    schedule = ServingFaultSchedule(server, plan, seed=7,
+                                    join_timeout_s=60.0)
+    result = schedule.run(n_requests=2, n_new=6)
+    assert result.fired_on == "bcast"
+    assert result.degraded is not None
+    assert "SliceFollowerLost" in result.degraded
+    assert result.failed >= 1
+    assert result.close_s < 30.0
+    # The op stream latched dead: the runner refuses instantly, so the
+    # post-close lock check and any stop broadcast never re-wedged.
+    assert server._cache._ops.dead is not None
+
+
+def test_follower_death_schedule_replays_from_seed(params, mesh):
+    """Same seed, fresh server -> identical seam trace and outcome —
+    the replay contract a failing schedule is debugged with."""
+    traces = []
+    for _ in range(2):
+        plan = FaultPlan(seed=11, kinds=("hang",), fire_window=(5, 6))
+        server = _slice_server(params, mesh, plan)
+        schedule = ServingFaultSchedule(server, plan, seed=11,
+                                        join_timeout_s=60.0)
+        result = schedule.run(n_requests=1, n_new=5)
+        assert result.degraded is not None
+        traces.append(result.trace)
+    assert traces[0] == traces[1]
+
+
+def test_broadcast_delay_past_deadline_is_typed(params, mesh):
+    """A broadcast that completes — but only after its deadline — is
+    indistinguishable from a dead follower at detection time and must
+    surface the same way: typed, pool poisoned, new submits refused
+    with a retry hint."""
+    plan = FaultPlan(seed=3, kinds=("delay",), fire_window=(5, 6),
+                     delay_s=8.0)
+    cache = SlicePagedKVCache(
+        CFG, slots=3, pages=24, page_size=4, mesh=mesh,
+        op_budgets=OpBudgets(**BUDGETS),
+    )
+    server = PagedGenerationServer(params, CFG, cache=cache)
+    prompt = [5, 9, 2, 7, 1]
+    # Warm every op key (sync / prefill / window shapes) with a healthy
+    # identical request BEFORE arming the transport, so the delayed op
+    # is judged against the steady budget, not the compile budget.
+    server.submit(prompt, n_new=6)
+    FaultySliceTransport(cache, plan)
+    try:
+        with pytest.raises(ServingFailure) as exc_info:
+            server.submit(prompt, n_new=6)
+        assert isinstance(exc_info.value, DeviceOpTimeout)
+        assert server.degraded is not None
+        with pytest.raises(PoolPoisoned) as refused:
+            server.submit(prompt, n_new=4)
+        assert refused.value.retryable
+        assert refused.value.retry_after_s and refused.value.retry_after_s > 0
+        assert refused.value.__cause__ is not None
+    finally:
+        server.close()
+        plan.close()
+    assert not server._thread.is_alive()
+
+
+# ---- single-host injected failures --------------------------------------
+
+
+def test_injected_raise_mid_decode_poisons_typed(params):
+    """An untyped device-op exception in the decode loop is classified:
+    waiters get PoolPoisoned chained to the cause, stats flip degraded,
+    and a later submit is refused with the retry-after hint."""
+    plan = FaultPlan(seed=5, kinds=("raise",), fire_window=(2, 4))
+    cache = FaultyCache(CFG, slots=3, pages=24, page_size=4, plan=plan)
+    server = PagedGenerationServer(params, CFG, cache=cache)
+    prompt = [3, 1, 4, 1, 5]
+    try:
+        with pytest.raises(Exception) as exc_info:
+            server.submit(prompt, n_new=8)
+        err = exc_info.value
+        # Fired either on the submit path (raw InjectedFault, prefill
+        # seam) or in the decode loop (classified PoolPoisoned).
+        assert isinstance(err, (InjectedFault, PoolPoisoned))
+        if isinstance(err, PoolPoisoned):
+            assert isinstance(err.__cause__, InjectedFault)
+            assert server.degraded is not None
+            stats = server.stats()
+            assert stats["degraded"] == 1
+            assert "degraded_reason" in stats
+            with pytest.raises(PoolPoisoned):
+                server.submit(prompt, n_new=2)
+    finally:
+        server.close()
+    assert not server._thread.is_alive()
+
+
+def test_raise_mid_prefill_leaves_cotenants_unaffected(params):
+    """A non-terminal failure on ONE request's prefill (a bad op raising,
+    not a dead transport) kills that request only: the pool stays
+    healthy, a subsequent request decodes correctly."""
+    cache = FaultyCache(CFG, slots=2, pages=16, page_size=4, plan=None)
+    server = PagedGenerationServer(params, CFG, cache=cache)
+    prompt = [5, 9, 2, 7, 1]
+    try:
+        assert server.submit(prompt, n_new=4) == reference(
+            params, prompt, 4
+        )
+        # Arm: the very next seam is the failing request's prefill.
+        cache.plan = FaultPlan(seed=0, kinds=("raise",),
+                               fire_window=(0, 1))
+        with pytest.raises(InjectedFault):
+            server.submit([8, 6, 7], n_new=4)
+        cache.plan = None
+        assert server.degraded is None
+        assert server.stats()["degraded"] == 0
+        got = server.submit(prompt, n_new=6)
+        assert got == reference(params, prompt, 6)
+    finally:
+        server.close()
+
+
+def test_seeded_raise_schedules_hold_invariants(params):
+    """Sweep seeds: wherever the seeded raise lands (prefill, step,
+    window, or never reached), every request terminates typed, nothing
+    over-emits, the lock survives, close() is bounded. The harness
+    raises InvariantViolation with the seam trace on any breach."""
+    for seed in (0, 1, 2):
+        plan = FaultPlan(seed=seed, kinds=("raise",),
+                         fire_window=(0, 10))
+        cache = FaultyCache(CFG, slots=3, pages=24, page_size=4,
+                            plan=plan)
+        server = PagedGenerationServer(params, CFG, cache=cache)
+        schedule = ServingFaultSchedule(server, plan, seed=seed,
+                                        join_timeout_s=120.0)
+        result = schedule.run(n_requests=3, n_new=5)
+        assert result.completed + result.failed == 3
+
+
+# ---- prefix-cache persistence under a kill ------------------------------
+
+
+def test_kill_during_prefix_dump_never_tears_file(params, tmp_path,
+                                                  monkeypatch):
+    """A dump killed mid-write (simulated: the npz writer dies after
+    emitting partial bytes) must never tear the cache file: the
+    previous complete dump stays loadable — the atomic tmp+replace
+    discipline under the worst-case failure point."""
+    path = str(tmp_path / "prefix.npz")
+    server = PagedGenerationServer(params, CFG, slots=2, pages=16,
+                                   page_size=4)
+    try:
+        prompt = [7, 7, 7, 7, 2, 4, 6, 8, 1]  # two full 4-token pages
+        server.submit(prompt, n_new=3)
+        assert server.dump_prefix_cache(path, "fp-1") > 0
+        assert prefix_file_intact(path)
+        before = open(path, "rb").read()
+
+        real_savez = np.savez
+
+        def dying_savez(f, **arrays):
+            f.write(b"\x00partial")  # the bytes a killed writer leaves
+            raise KeyboardInterrupt("simulated SIGKILL mid-dump")
+
+        monkeypatch.setattr(np, "savez", dying_savez)
+        server.submit([9] * 8 + [1], n_new=3)  # dirty the registry
+        with pytest.raises(KeyboardInterrupt):
+            server.dump_prefix_cache(path, "fp-1")
+        monkeypatch.setattr(np, "savez", real_savez)
+
+        assert prefix_file_intact(path)
+        assert open(path, "rb").read() == before
+    finally:
+        server.close()
+    # The intact old dump re-pins into a fresh server.
+    server2 = PagedGenerationServer(params, CFG, slots=2, pages=16,
+                                    page_size=4)
+    try:
+        assert server2.load_prefix_cache(path, "fp-1") > 0
+    finally:
+        server2.close()
+
+
+def test_degraded_pool_emergency_dump_is_intact(params, tmp_path):
+    """When a poisoned pool's emergency prefix dump runs (single-host
+    pool, still readable), the file it leaves is complete; the degraded
+    observer fires with the typed failure."""
+    path = str(tmp_path / "prefix.npz")
+    plan = FaultPlan(seed=1, kinds=("raise",), fire_window=(3, 4))
+    cache = FaultyCache(CFG, slots=2, pages=16, page_size=4, plan=plan)
+    server = PagedGenerationServer(params, CFG, cache=cache)
+    observed = []
+    server.on_degraded = lambda reason, failure: observed.append(
+        (reason, failure)
+    )
+    server._persist_path, server._persist_fp = path, "fp-1"
+    prompt = [7, 7, 7, 7, 2, 4, 6, 8, 1]
+    try:
+        with pytest.raises(ServingFailure):
+            server.submit(prompt, n_new=8)
+        # The decode loop exits (poisoned) and runs the degraded path;
+        # wait for it rather than racing the observer.
+        server._thread.join(timeout=30)
+        assert not server._thread.is_alive()
+        assert server.degraded is not None
+        assert observed and isinstance(observed[0][1], ServingFailure)
+        assert prefix_file_intact(path)
+    finally:
+        server.close()
+        plan.close()
+
+
+# ---- typed taxonomy basics ----------------------------------------------
+
+
+def test_slice_follower_lost_is_terminal_pool_poisoned_retryable():
+    lost = SliceFollowerLost("gone", op=("step",), budget_s=1.0)
+    assert not lost.retryable
+    assert isinstance(lost, DeviceOpTimeout)
+    poisoned = PoolPoisoned("pool died")
+    assert poisoned.retryable
+    assert poisoned.retry_after_s > 0
+
+
+def test_deadline_runner_latches_dead_and_refuses():
+    from kvedge_tpu.runtime.failures import DeadlineRunner
+
+    runner = DeadlineRunner(OpBudgets(steady_s=0.2, compile_s=0.2))
+    release = threading.Event()
+    with pytest.raises(DeviceOpTimeout) as exc_info:
+        runner.run(("wedge",), lambda: release.wait(60))
+    assert exc_info.value.op == ("wedge",)
+    assert runner.dead == str(("wedge",))
+    # Later ops refuse instantly without touching the (orphaned) worker.
+    with pytest.raises(DeviceOpTimeout):
+        runner.run(("next",), lambda: 1)
+    release.set()

@@ -259,3 +259,52 @@ def test_slice_cache_refuses_prefix_persistence(params, mesh):
         cache.read_pages([0])
     with pytest.raises(PagedCacheError, match="single-host|not supported"):
         cache.write_pages([0], None, None)
+
+
+def test_slice_cache_pins_gather_attention(mesh):
+    """A slice cache downgrades even an explicit 'kernel' to the gather
+    path: the Pallas kernel has no partitioning rule, so a sharded
+    trace would poison the first decode step on a real slice. The pin
+    is part of the construction protocol (every process replaces cfg
+    identically), so it must hold before any device op runs."""
+    import dataclasses
+
+    forced = dataclasses.replace(CFG, paged_attention="kernel")
+    cache = SlicePagedKVCache(
+        forced, slots=2, pages=8, page_size=4, mesh=mesh
+    )
+    assert cache.cfg.paged_attention == "gather"
+    auto = SlicePagedKVCache(
+        CFG, slots=2, pages=8, page_size=4, mesh=mesh
+    )
+    assert auto.cfg.paged_attention == "gather"
+
+
+def test_slice_stop_after_dead_stream_is_bounded(params, mesh):
+    """stop() must not broadcast into a dead op stream: once the
+    watchdog latched an op timeout, close() returns without queuing the
+    STOP collective the departed followers would never join."""
+    import time as _time
+
+    from kvedge_tpu.runtime.failures import OpBudgets, SliceFollowerLost
+
+    cache = SlicePagedKVCache(
+        CFG, slots=2, pages=16, page_size=4, mesh=mesh,
+        op_budgets=OpBudgets(steady_s=0.5, compile_s=0.5),
+    )
+    release = threading.Event()
+    orig = cache._bcast
+
+    def wedged(tree):
+        release.wait(30)
+        raise RuntimeError("wedged bcast released")
+
+    cache._bcast = wedged
+    with pytest.raises(SliceFollowerLost):
+        cache.admit(0, 4)  # admit syncs tables -> first broadcast wedges
+    assert cache._ops.dead is not None
+    cache._bcast = orig
+    start = _time.monotonic()
+    cache.stop()
+    assert _time.monotonic() - start < 5.0
+    release.set()

@@ -24,6 +24,9 @@ Usage::
 
     python tools/run_tests.py            # full suite, sharded
     python tools/run_tests.py -k serving # filtered, still sharded
+    python tools/run_tests.py --faults   # only the seeded fault-injection
+                                         # tests (-m fault); they are fast
+                                         # and also part of tier-1
     python tools/run_tests.py --list     # show the shard plan only
 
 Prints a per-shard progress line and ONE aggregate summary; exits 0
@@ -146,10 +149,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="max tests per fresh process (default 250)")
     ap.add_argument("--list", action="store_true",
                     help="print the shard plan and exit")
+    ap.add_argument("--faults", action="store_true",
+                    help="run only the seeded serving fault-injection "
+                         "tests (forwards -m fault)")
     ap.add_argument("pytest_args", nargs="*",
                     help="extra args forwarded to pytest (e.g. -k expr)")
     args, unknown = ap.parse_known_args(argv)
     args.pytest_args = unknown + args.pytest_args
+    if args.faults:
+        args.pytest_args += ["-m", "fault"]
 
     counts = collect_counts(args.pytest_args)
     if not counts:
