@@ -324,6 +324,20 @@ class RuntimeConfig:
     # an explicit K keeps the operator's choice but logs a loud
     # warning under the same test (single-host serve only).
     serving_speculative: int | str = 0
+    # Retry-after hint (seconds) carried by poisoned-pool refusals and
+    # /healthz while degraded — what a refused client is told to wait
+    # before retrying. When the recovery supervisor is active and a
+    # heal is in flight, the hint is overridden by the MEASURED
+    # recovery time; this static value is the fallback (no supervisor,
+    # or no recovery has completed yet).
+    serving_retry_after_s: float = 30.0
+    # In-process recovery for the paged serving pool (SERVING.md rung
+    # 15): how many heal attempts (slice reformation + warm restart,
+    # exponential backoff between them) the supervisor makes before
+    # escalating to the terminal 503 / reschedule path. 0 disables the
+    # supervisor entirely — every poisoning failure is immediately
+    # terminal, the pre-rung-15 behavior.
+    serving_recovery_attempts: int = 2
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -464,6 +478,14 @@ class RuntimeConfig:
                     payload_doc.get("serving_speculative",
                                     cls.serving_speculative)
                 ),
+                serving_retry_after_s=float(
+                    payload_doc.get("serving_retry_after_s",
+                                    cls.serving_retry_after_s)
+                ),
+                serving_recovery_attempts=int(
+                    payload_doc.get("serving_recovery_attempts",
+                                    cls.serving_recovery_attempts)
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -559,6 +581,16 @@ class RuntimeConfig:
                 "[payload] serving_speculative (draft length) must be "
                 "in [0, 16] (0 = off) or 'auto'"
             )
+        if self.serving_retry_after_s <= 0:
+            raise RuntimeConfigError(
+                "[payload] serving_retry_after_s must be > 0 "
+                "(seconds a refused client should wait before retrying)"
+            )
+        if self.serving_recovery_attempts < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_recovery_attempts must be >= 0 "
+                "(0 = no in-process recovery; degrade is terminal)"
+            )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
                 "[payload] kind = 'train' requires corpus = '<path>' "
@@ -640,6 +672,8 @@ class RuntimeConfig:
             f"serving_window = {self.serving_window}\n"
             "serving_speculative = "
             f"{s(self.serving_speculative) if isinstance(self.serving_speculative, str) else self.serving_speculative}\n"
+            f"serving_retry_after_s = {self.serving_retry_after_s}\n"
+            f"serving_recovery_attempts = {self.serving_recovery_attempts}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

@@ -176,7 +176,8 @@ class PagedGenerationServer:
                  pages: int = 64, page_size: int = 16,
                  prefill_chunk: int = 0, prefix_cache: bool = True,
                  speculative: int = 0, window: int = 64,
-                 kv_dtype: str = "", cache=None):
+                 kv_dtype: str = "", cache=None,
+                 retry_after_s: float | None = None):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
@@ -290,6 +291,13 @@ class PagedGenerationServer:
         # outside the lock, when the pool poisons — e.g. to persist a
         # post-mortem failure record in the state dir.
         self.on_degraded = None
+        # Retry-after hint for poisoned-pool refusals: a static default
+        # ([payload] serving_retry_after_s; None = taxonomy default),
+        # overridden live by ``retry_after_hint`` — a () -> float|None
+        # callable the recovery supervisor installs so refusals carry
+        # the MEASURED recovery time while a heal is in flight.
+        self._retry_after_s = retry_after_s
+        self.retry_after_hint = None
         # Recorded by start_prefix_persistence so a poisoned-but-
         # readable pool can emergency-dump its warm prefixes on the
         # way down.
@@ -359,9 +367,18 @@ class PagedGenerationServer:
         learns it may retry (against the rescheduled pod) and how long
         to wait, instead of a terminal-looking shutdown error."""
         if self._poison is not None:
+            hint = None
+            if self.retry_after_hint is not None:
+                try:
+                    hint = self.retry_after_hint()
+                except Exception:
+                    hint = None
+            if hint is None:
+                hint = self._retry_after_s
             e = PoolPoisoned(
                 f"serving pool is poisoned ({self._degraded_reason}); "
-                f"retry against the rescheduled pod"
+                f"retry against the recovered or rescheduled pod",
+                **({} if hint is None else {"retry_after_s": hint}),
             )
             e.__cause__ = self._poison
             return e
@@ -1020,6 +1037,61 @@ class PagedGenerationServer:
             except Exception as e:  # observers never re-poison teardown
                 print(f"[kvedge-serve] on_degraded observer failed: "
                       f"{e!r}", flush=True)
+
+    def revive(self, *, prefill_wait_s: float = 30.0) -> None:
+        """Warm-restart a poisoned pool in place (recovery supervisor).
+
+        Pre-condition: the failed op stream is live again — for a slice
+        cache the supervisor runs ``cache.reform()`` FIRST, because the
+        slot releases below flow ``_sync`` ops to the (re-joined)
+        followers. Raises RuntimeError when the pool is not poisoned or
+        its decode loop has not finished exiting.
+
+        The scrub drops everything poisoning stranded: prefix-registry
+        pins are evicted (the device K/V behind them is suspect after a
+        failure — the emergency dump reloads them from the reusable
+        snapshot), every still-admitted slot is released, and the
+        slot/reservation books reset to empty. In-flight requests were
+        already failed by ``_poison_locked``; compiled programs survive
+        untouched — that is the point of reviving over rescheduling.
+        """
+        # The dying decode thread must be gone before a replacement
+        # starts (two loops over one pool would interleave cache calls).
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            raise RuntimeError("decode loop still running; cannot revive")
+        deadline = time.monotonic() + prefill_wait_s
+        with self._work:
+            if self._poison is None:
+                raise RuntimeError("pool is not poisoned; nothing to revive")
+            # Chunked prefills caught mid-flight by the poison fail on
+            # their next cache call and decrement under the lock; wait
+            # them out so none can land tokens into the reset pool.
+            while self._prefilling > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise RuntimeError(
+                        f"{self._prefilling} prefill(s) still in flight "
+                        f"after {prefill_wait_s:g}s; cannot revive"
+                    )
+                self._work.wait(timeout=left)
+            for node in list(self._prefix_entry_nodes):
+                self._evict_prefix_node(node)
+            for slot in range(self._cache.slots):
+                if self._cache.is_admitted(slot):
+                    self._cache.release(slot)
+            self._free_slots = list(range(self._cache.slots))[::-1]
+            self._reserved = 0
+            self._active.clear()
+            self._poison = None
+            self._degraded_reason = None
+            self._closed = False
+            self._draining = False
+            self._thread = threading.Thread(
+                target=self._loop, name="kvedge-paged-serve", daemon=True
+            )
+            self._thread.start()
+            self._work.notify_all()
 
     def stats(self) -> dict:
         with self._lock:

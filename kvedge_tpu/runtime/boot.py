@@ -17,7 +17,7 @@ from typing import Callable
 
 from kvedge_tpu.config.runtime_config import RuntimeConfig
 from kvedge_tpu.parallel.distributed import DistributedState, maybe_initialize
-from kvedge_tpu.runtime import heartbeat
+from kvedge_tpu.runtime import heartbeat, recovery
 from kvedge_tpu.runtime.devicecheck import DeviceCheckResult, run_device_check
 from kvedge_tpu.runtime.profiling import CaptureUnavailable, TraceCapture
 from kvedge_tpu.runtime.status import GenerateUnavailable, StatusServer
@@ -241,10 +241,26 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
 
     def health_detail() -> dict | None:
         # Enriches an unhealthy /healthz body. A poisoned serving pool
-        # is terminal — it never recovers in place, only by rescheduling
-        # — so probes (healthcheck.wait_healthy) stop polling early.
+        # under active recovery (runtime/recovery.py) reports 503
+        # NON-terminal with a retry-after hint, so probes
+        # (healthcheck.wait_healthy) keep polling through the heal;
+        # without a supervisor — or after its escalation — the poison
+        # is terminal (it only clears by rescheduling) and probes stop
+        # polling early.
         reason = serve_degraded()
         if reason is not None:
+            rec = getattr(handle.serve_fn, "recovery", None)
+            if rec is not None:
+                try:
+                    doc = rec()
+                except Exception:
+                    doc = None
+                if doc and doc.get("state") == "recovering":
+                    out = {"reason": reason, "terminal": False,
+                           "recovering": True}
+                    if doc.get("retry_after_s") is not None:
+                        out["retry_after_s"] = doc["retry_after_s"]
+                    return out
             return {"reason": reason, "terminal": True}
         if not handle.check.ok and handle.check.error:
             return {"reason": handle.check.error}
@@ -264,6 +280,14 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
         boot_count=boot_count, started_at=started_at,
         distributed=DistributedState(active=False),
     )
+    # Sweep atomic-write leftovers before anything writes to the state
+    # dir: a SIGKILL mid-dump strands `<name>.tmp` (a prefix dump can be
+    # hundreds of MB) and no other writer exists this early, so every
+    # surviving tmp is garbage by definition.
+    swept = recovery.sweep_stranded_tmp(cfg.state_dir)
+    if swept:
+        print(f"[kvedge-boot] swept {len(swept)} stranded tmp file(s) "
+              f"from the state dir: {', '.join(swept)}", flush=True)
     writer.beat_once()  # heartbeat visible before the server answers
     server.start()
 

@@ -4,8 +4,11 @@ The serving stack's failure story used to stop at "slice-fatal, by
 policy": a follower wedged in a collective left the leader blocked
 forever, holding the server's work lock, and close() documented the
 hang rather than preventing it (sliceserve.py's old module docstring;
-serving.py close()). This module is the detection half of the recovery
-contract:
+serving.py close()). This module is the DETECTION half of the recovery
+contract — the RECOVERY half lives in runtime/recovery.py, whose
+supervisor turns the degraded mode these types produce into slice
+reformation and warm restart, escalating to the terminal/reschedule
+path only when healing keeps failing:
 
 * a small exception hierarchy every layer agrees on — what failed,
   whether a client should retry, and how soon;
@@ -35,9 +38,13 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Hashable
 
-# Client guidance carried by retryable failures: a poisoned pool means
-# the pod is about to be rescheduled (healthz flips 503, the chart's
-# StatefulSet replaces it), so "retry after the reschedule window".
+# Client guidance carried by retryable failures: how long a refused
+# client should wait before retrying — roughly the reschedule window
+# (or an in-process recovery, which is much faster). The operator knob
+# is ``[payload] serving_retry_after_s`` (RuntimeConfig), threaded into
+# PagedGenerationServer; when the recovery supervisor is active the
+# hint is the MEASURED recovery time instead. This constant is only
+# the last-resort default for failures raised outside that wiring.
 DEFAULT_RETRY_AFTER_S = 30.0
 
 
@@ -74,8 +81,11 @@ class DeviceOpTimeout(ServingFailure):
 
 class SliceFollowerLost(DeviceOpTimeout):
     """A slice op (header send / broadcast / exec) blew its deadline —
-    a follower is dead or wedged. Slice-fatal: the leader's op stream
-    is unusable from this point; recovery is rescheduling the slice."""
+    a follower is dead or wedged. The leader's op stream is unusable
+    from this point; recovery is slice reformation (a fresh op stream
+    + barrier SYNC the rejoined follower replays — sliceserve.reform,
+    driven by runtime/recovery.py), falling back to rescheduling the
+    slice when reformation keeps failing."""
 
 
 class PoolPoisoned(ServingFailure):

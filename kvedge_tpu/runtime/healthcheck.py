@@ -10,10 +10,14 @@ because ``helm test`` is typically run right after install, while the
 runtime may still be compiling its first payload or waiting for
 multi-host peers — the status server serves 503 until boot completes.
 
-One 503 is *not* worth polling out: a poisoned serving pool
-(runtime/failures.py) marks its /healthz body ``"terminal": true``
-because it only recovers by rescheduling — the probe fails fast so the
-operator (or CI) learns in seconds, not after the full deadline.
+One 503 is *not* worth polling out: a poisoned serving pool that has
+exhausted (or never had) in-process recovery marks its /healthz body
+``"terminal": true`` because it only recovers by rescheduling — the
+probe fails fast so the operator (or CI) learns in seconds, not after
+the full deadline. A pool the recovery supervisor is actively healing
+(runtime/recovery.py) answers 503 NON-terminal with ``"recovering":
+true`` and a retry-after hint, and the probe rightly keeps polling:
+healthy may be seconds away.
 
 Usable standalone against any deployment:
 
@@ -35,9 +39,11 @@ def wait_healthy(url: str, deadline_s: float = 240.0,
     """Poll ``url`` until HTTP 200 or deadline. Returns (ok, last_detail).
 
     A 503 whose JSON body carries ``"terminal": true`` (a poisoned
-    serving pool — boot.py's health_detail) returns failure immediately:
-    that state never clears without a reschedule, so continuing to poll
-    would only delay the verdict.
+    serving pool past recovery — boot.py's health_detail) returns
+    failure immediately: that state never clears without a reschedule,
+    so continuing to poll would only delay the verdict. A non-terminal
+    503 — booting, or ``"recovering": true`` while the recovery
+    supervisor heals the pool in place — keeps polling to the deadline.
     """
     deadline = time.monotonic() + deadline_s
     detail = "no attempt made"

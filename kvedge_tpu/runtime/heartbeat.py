@@ -56,6 +56,28 @@ def read_init_events(state_dir: str, tail: int = INIT_EVENTS_TAIL) -> list:
     return events
 
 
+def append_init_event(state_dir: str, doc: dict) -> dict:
+    """Append one lifecycle event to ``init-events.jsonl``, stamped with
+    ts and the current boot_count.
+
+    The native PID-1 supervisor is the file's primary author; the
+    in-process recovery supervisor (runtime/recovery.py) appends its
+    own outcomes here so its crash-loop breaker shares the same
+    cross-generation memory. Append-only single-line writes are atomic
+    enough for the tail reader above (a torn final line is skipped).
+    """
+    os.makedirs(state_dir, exist_ok=True)
+    record = dict(doc)
+    record["ts"] = time.time()
+    record.setdefault("boot_count", int(
+        (read_heartbeat(state_dir) or {}).get("boot_count", 0)
+    ))
+    path = os.path.join(state_dir, INIT_EVENTS_FILE)
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(record) + "\n")
+    return record
+
+
 def _read_json_doc(path: str) -> dict | None:
     """One JSON object from ``path``, or None if absent/corrupt."""
     try:

@@ -27,18 +27,23 @@ Why this is sound:
   page boundaries the decode loop dispatches one WINDOW op per
   ``page_size`` greedy tokens; the cross-host control traffic rides the
   same cadence as the single-host loop's host reads.
-* **Failure is slice-fatal, but bounded.** A follower that dies used
-  to leave the leader blocked in a collective forever, holding the
-  server's work lock. Every leader-side op now runs through a
-  :class:`~kvedge_tpu.runtime.failures.DeadlineRunner` with
+* **Failure is bounded, and no longer always fatal.** A follower that
+  dies used to leave the leader blocked in a collective forever,
+  holding the server's work lock. Every leader-side op now runs
+  through a :class:`~kvedge_tpu.runtime.failures.DeadlineRunner` with
   compile-aware budgets: a wedged op is orphaned on the op thread and
   surfaces as a typed
   :class:`~kvedge_tpu.runtime.failures.SliceFollowerLost`, the op
   stream latches dead, and the serving layer degrades (poisons
-  in-flight requests, refuses new ones, keeps ``close()`` bounded)
-  while the chart's StatefulSet restarts the slice. Rejoin-at-a-
-  boundary remains rejected (SERVING.md) — detection is cheap, a
-  follower state machine is not.
+  in-flight requests, refuses new ones, keeps ``close()`` bounded).
+  The recovery supervisor (runtime/recovery.py, SERVING.md rung 15)
+  then tries to heal in place: :meth:`SlicePagedKVCache.reform`
+  installs a fresh op stream and runs a deadline-bounded barrier SYNC
+  that a re-entered follower replays as its first op. Only when
+  reformation keeps failing does the old story — reschedule the slice
+  — take over. A full follower *state machine* (rejoin mid-stream at
+  an arbitrary op) remains rejected; rejoin at the reformation
+  barrier is the one boundary cheap enough to keep.
 
 The reference has no serving and no multi-host anything (SURVEY.md §0,
 §5); this module is the last rung of the serving ladder this repo
@@ -172,10 +177,15 @@ class SlicePagedKVCache(PagedKVCache):
         # Leader-side watchdog over the op stream (header send,
         # broadcast, exec): a wedged collective surfaces as a typed
         # SliceFollowerLost instead of an eternal hang holding the
-        # server's work lock. Followers keep the raw slice-fatal
-        # contract — their recovery path is the pod dying.
+        # server's work lock. Followers run a bounded rejoin loop
+        # (runtime/workload.py) before giving up and letting the pod
+        # die. The budgets object is kept: reform() builds each
+        # replacement runner over the SAME instance, so compiled-key
+        # knowledge survives — a program compiled before the failure
+        # keeps its steady budget after the heal.
+        self._op_budgets = op_budgets if op_budgets is not None else OpBudgets()
         self._ops = DeadlineRunner(
-            op_budgets, failure=SliceFollowerLost,
+            self._op_budgets, failure=SliceFollowerLost,
             name="kvedge-slice-ops",
         )
         super().__init__(
@@ -454,6 +464,60 @@ class SlicePagedKVCache(PagedKVCache):
         except DeviceOpTimeout:
             pass
 
+    def reform(self, *, budget_s: float | None = None) -> None:
+        """Leader: replace a dead op stream and re-form the slice
+        (recovery supervisor, runtime/recovery.py).
+
+        The dead :class:`DeadlineRunner`'s worker is parked on the
+        wedged collective forever — it is shut down and abandoned, and
+        a FRESH runner over the SAME :class:`OpBudgets` (compiled
+        programs survived, so already-seen keys keep steady budgets)
+        takes its place. Then one deadline-bounded **barrier SYNC**
+        flows through it: a follower that re-entered
+        :func:`follow_paged` replays it as its first op, re-syncing
+        tables/lengths, and its success proves every follower is back
+        in the collective. On timeout the fresh runner latches dead and
+        the typed :class:`SliceFollowerLost` propagates — the old
+        (also dead) stream state is effectively unchanged and the
+        caller's next attempt, or escalation, takes over.
+
+        ``budget_s`` bounds the barrier (None = the stream's steady
+        budget — the SYNC program was compiled long before the
+        failure). Raises PagedCacheError after ``stop()``: released
+        followers are gone by contract, not by failure.
+        """
+        if self._stopped:
+            raise PagedCacheError(
+                "slice serve is stopped — the followers were released, "
+                "not lost; there is nothing to re-form"
+            )
+        old, self._ops = self._ops, DeadlineRunner(
+            self._op_budgets, failure=SliceFollowerLost,
+            name="kvedge-slice-ops",
+        )
+        old.shutdown()
+        tables = np.asarray(self._host_tables, np.int32)
+        lengths = np.asarray(self._host_lengths, np.int32)
+
+        def op():
+            self._send_header(OP_SYNC)
+            return self._bcast((tables, lengths))
+
+        try:
+            got = self._ops.run(
+                ("reform-barrier",), op,
+                budget_s=budget_s if budget_s is not None
+                else self._ops.steady_s,
+            )
+        except SliceFollowerLost:
+            # The fresh stream latched dead on the barrier: the
+            # followers are still gone. State is exactly as before the
+            # call (a dead stream installed) — re-entrant for the next
+            # attempt.
+            raise
+        t, l = got
+        self._apply_sync(np.asarray(t), np.asarray(l))
+
     # ---- follower side ---------------------------------------------------
 
     def _follow_op(self, params) -> bool:
@@ -516,9 +580,15 @@ class SlicePagedKVCache(PagedKVCache):
 def follow_paged(cache: SlicePagedKVCache, params) -> None:
     """Follower loop: replay the leader's op stream until STOP.
 
-    Any exception here is slice-fatal (the leader will block in its
-    next collective); the caller logs and lets the pod die — the
-    StatefulSet restart IS the recovery path, same as training.
+    An exception here means this follower fell out of the collective
+    (the leader's deadline watchdog will type it SliceFollowerLost and
+    degrade the pool). The caller (runtime/workload.py) RE-ENTERS this
+    loop a bounded number of times: the rejoined follower's first
+    received op is the leader's reformation barrier SYNC (a shape it
+    always knows how to replay), which restores its tables/lengths and
+    puts it back in lockstep. Only when the rejoin budget is exhausted
+    does the caller let the pod die — the StatefulSet restart remains
+    the recovery path of last resort.
     """
     while cache._follow_op(params):
         pass

@@ -102,11 +102,30 @@ _SERVE_METRIC_FIELDS = (
      "mean greedy tokens emitted per verify pass — the realized "
      "speculative acceleration (paged backend)"),
     # Failure surface (runtime/failures.py): 1 once the pool has been
-    # poisoned by a terminal serving failure — the alert-on signal that
-    # this pod needs rescheduling, not retrying.
+    # poisoned by a serving failure. With the recovery supervisor active
+    # (runtime/recovery.py) this clears again after a successful heal —
+    # alert on degraded AND NOT recovering for the reschedule signal.
     ("degraded", "serve_degraded", "gauge",
-     "1 if the serving pool is poisoned/degraded (terminal failure; "
-     "the pod should be rescheduled)"),
+     "1 if the serving pool is poisoned/degraded (clears after an "
+     "in-process recovery; without one, the pod should be rescheduled)"),
+    # Recovery machine (runtime/recovery.py): attempt/outcome counters
+    # plus the in-flight gauge /healthz keys its non-terminal 503 off.
+    ("recovering", "serve_recovering", "gauge",
+     "1 while the recovery supervisor is actively healing the pool "
+     "(degrade is not terminal yet)"),
+    ("recovery_attempts_total", "serve_recovery_attempts_total",
+     "counter",
+     "individual heal attempts (teardown + reformation + warm restart) "
+     "the recovery supervisor has made"),
+    ("recoveries_total", "serve_recoveries_total", "counter",
+     "successful in-process recoveries (pool returned to healthy)"),
+    ("recovery_failures_total", "serve_recovery_failures_total",
+     "counter",
+     "recoveries that escalated to the terminal path (attempt budget "
+     "exhausted or crash-loop breaker tripped)"),
+    ("last_recovery_s", "serve_last_recovery_seconds", "gauge",
+     "wall-clock seconds the most recent successful recovery took "
+     "(also the basis of the degraded-refusal retry-after hint)"),
 )
 
 

@@ -946,19 +946,34 @@ def _run_multihost_paged_serve(cfg, base, tcfg, mesh, restored_step,
 
     if jax.process_index() != 0:
         def follow():
-            try:
-                follow_paged(cache, params)
-            except Exception as e:  # pragma: no cover - slice-fatal
-                # Slice-fatal MEANS the pod dies: a swallowed replay
-                # failure would leave this pod answering /healthz while
-                # the leader wedges in a collective forever. Exiting
-                # non-zero makes the StatefulSet restart the slice —
-                # the recovery path SERVING.md commits to.
-                print(f"[kvedge-serve] paged follower died: {e!r}",
-                      flush=True)
-                import os as os_mod
+            # Bounded rejoin (SERVING.md rung 15): a replay failure no
+            # longer kills the pod on the first strike. The follower
+            # re-enters follow_paged — its first received op is the
+            # leader's reformation barrier SYNC, which restores
+            # tables/lengths and puts it back in lockstep. The budget
+            # mirrors the leader supervisor's attempt budget; when it
+            # is exhausted (or recovery is disabled) the old contract
+            # holds: exit non-zero so the StatefulSet restarts the
+            # slice — a swallowed replay failure would leave this pod
+            # answering /healthz while the leader wedges forever.
+            rejoins = max(0, int(cfg.serving_recovery_attempts))
+            tries = 0
+            while True:
+                try:
+                    follow_paged(cache, params)
+                    return  # leader broadcast STOP: clean end of serve
+                except Exception as e:
+                    tries += 1
+                    if tries > rejoins:  # pragma: no cover - slice-fatal
+                        print(f"[kvedge-serve] paged follower died "
+                              f"({tries - 1} rejoin(s) spent): {e!r}",
+                              flush=True)
+                        import os as os_mod
 
-                os_mod._exit(13)
+                        os_mod._exit(13)
+                    print(f"[kvedge-serve] paged follower dropped from "
+                          f"the op stream ({e!r}); rejoining "
+                          f"({tries}/{rejoins})", flush=True)
 
         thread = threading.Thread(
             target=follow, name="kvedge-serve-follow", daemon=True
@@ -1162,7 +1177,15 @@ def run_serve_payload(cfg: RuntimeConfig):
         # runs under jit with the input shardings driving XLA's SPMD
         # partitioner, exactly like the train step.
         restored_step, params = _restore_latest_params(cfg, tcfg, mesh=mesh)
-        return _build_serve(cfg, base, tcfg, params, restored_step)
+        # The recovery supervisor's warm restart re-reads the latest
+        # checkpoint (single-host only: a slice restore is a collective
+        # the supervisor's thread must not run alone).
+        return _build_serve(
+            cfg, base, tcfg, params, restored_step,
+            restore_params=lambda: _restore_latest_params(
+                cfg, tcfg, mesh=mesh
+            )[1],
+        )
     except MeshConfigError as e:
         # Raised before any server/device state exists: surface the
         # operator-facing config message, not a wrapped traceback.
@@ -1174,7 +1197,7 @@ def run_serve_payload(cfg: RuntimeConfig):
 
 
 def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
-                 backend=None):
+                 backend=None, restore_params=None):
     """Build the serve endpoint over restored ``params``.
 
     The ONE construction of the serving data path, shared by the
@@ -1200,6 +1223,7 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
     max_rows = 4 * cfg.serving_slots
     row_pool = None
     paged_server = None
+    recovery_sup = None
     prefix_path, fp = "", ""
     try:
         if cache is not None or cfg.payload_serving == "paged":
@@ -1219,6 +1243,7 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 window=cfg.serving_window,
                 kv_dtype=cfg.serving_kv_dtype,
                 cache=cache,
+                retry_after_s=cfg.serving_retry_after_s,
             )
             # Degraded-mode observability: when the pool poisons
             # (runtime/failures.py), persist a post-mortem failure
@@ -1296,6 +1321,30 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 paged_server.start_prefix_persistence(
                     prefix_path, fp, interval=30.0
                 )
+            # Self-healing (SERVING.md rung 15): the supervisor chains
+            # onto on_degraded AFTER the failure-record observer above
+            # (attach() preserves it), so a poisoning failure is first
+            # recorded, then healed — slice reformation + warm restart
+            # with backoff — and only escalates to the terminal 503 /
+            # reschedule path when the attempt budget or the crash-loop
+            # breaker says in-process recovery is not working.
+            if cfg.serving_recovery_attempts > 0:
+                from kvedge_tpu.runtime.recovery import (
+                    RecoveryPolicy,
+                    RecoverySupervisor,
+                )
+
+                recovery_sup = RecoverySupervisor(
+                    paged_server,
+                    policy=RecoveryPolicy(
+                        max_attempts=cfg.serving_recovery_attempts,
+                    ),
+                    state_dir=cfg.state_dir,
+                    prefix_path=prefix_path,
+                    prefix_fingerprint=fp,
+                    restore_params=(restore_params if cache is None
+                                    else None),
+                ).attach()
             # One shared pool for row priming AND stream pumping, sized
             # 2x slots (only `slots` rows decode concurrently; one
             # primer + one pump each is the useful parallelism). Excess
@@ -1592,6 +1641,10 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 # Pool occupancy straight from the server (in_flight,
                 # free_slots, free_pages, reserved_pages).
                 out.update(paged_server.stats())
+            if recovery_sup is not None:
+                # Recovery-machine gauges/counters (serve_recovering,
+                # attempt totals) ride the same snapshot.
+                out.update(recovery_sup.stats())
             return out
 
         serve_fn.stats = serve_stats
@@ -1602,6 +1655,11 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
             (lambda: paged_server.degraded)
             if paged_server is not None else (lambda: None)
         )
+        # Recovery-machine probe for /healthz: while the supervisor is
+        # recovering, boot.health_detail reports 503 NON-terminal with
+        # a retry-after hint; terminal only after escalation.
+        if recovery_sup is not None:
+            serve_fn.recovery = recovery_sup.health
 
         # Self-check: one tiny generation proves the restored params and
         # the decode path actually work before the endpoint goes live.
@@ -1626,6 +1684,10 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
         # serve_fn.close(). drain=True finishes in-flight budgets
         # before stopping (models/serving.py close semantics).
         def _close(drain: bool = False) -> None:
+            if recovery_sup is not None:
+                # A recovery racing shutdown must not revive a pool the
+                # close below is tearing down.
+                recovery_sup.stop()
             if paged_server is not None:
                 paged_server.close(drain=drain)
                 if prefix_path:
@@ -1656,6 +1718,8 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
         # paged_server.close() also releases a slice cache's followers
         # (the cache.stop hook); if the failure desynced the broadcast
         # stream the slice is already lost (restart path).
+        if recovery_sup is not None:
+            recovery_sup.stop()
         if paged_server is not None:
             paged_server.close()
         if row_pool is not None:

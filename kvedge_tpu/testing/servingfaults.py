@@ -70,19 +70,29 @@ class FaultPlan:
     A parked seam raises after release rather than completing, so an
     orphaned op thread can never mutate cache state behind a pool that
     already poisoned.
+
+    By default the plan fires ONCE — the original contract, under which
+    every seam after ``fire_at`` succeeds. ``heal_at`` switches to
+    **outage-window** semantics for recovery schedules: every seam in
+    ``[fire_at, heal_at)`` fires (the follower is *gone*, not
+    glitching), and seams from ``heal_at`` on succeed (the follower
+    rejoined). ``heal_at`` far beyond any reachable seam count models a
+    follower that never comes back — the escalation path.
     """
 
     def __init__(self, seed: int, *, kinds=("raise", "hang", "delay"),
                  fire_window: tuple[int, int] = (1, 12),
-                 delay_s: float = 0.0):
+                 delay_s: float = 0.0, heal_at: int | None = None):
         rng = random.Random(seed)
         self.kind = rng.choice(list(kinds))
         self.fire_at = rng.randrange(*fire_window)
+        self.heal_at = heal_at
         self.delay_s = delay_s
         self.count = 0
         self.fired_on: str | None = None
         self.trace: list[str] = [
             f"[plan] seed={seed} kind={self.kind} fire_at={self.fire_at}"
+            + (f" heal_at={heal_at}" if heal_at is not None else "")
         ]
         self._release = threading.Event()
         self._lock = threading.Lock()
@@ -92,8 +102,11 @@ class FaultPlan:
         with self._lock:
             i = self.count
             self.count += 1
-            fire = i == self.fire_at and self.fired_on is None
-            if fire:
+            if self.heal_at is None:
+                fire = i == self.fire_at and self.fired_on is None
+            else:
+                fire = self.fire_at <= i < self.heal_at
+            if fire and self.fired_on is None:
                 self.fired_on = label
             self.trace.append(
                 f"[{i}] {label}" + (f" <- {self.kind}" if fire else "")
@@ -167,6 +180,7 @@ class FaultySliceTransport:
     """
 
     def __init__(self, cache, plan: FaultPlan):
+        self._cache = cache
         self._orig = cache._bcast
         self.plan = plan
         cache._bcast = self._bcast
@@ -174,6 +188,13 @@ class FaultySliceTransport:
     def _bcast(self, tree):
         self.plan.at_seam("bcast")
         return self._orig(tree)
+
+    def heal(self) -> None:
+        """Unhook: restore the cache's real transport. Use with a
+        fire-once plan to model 'the follower is back'; plans with
+        ``heal_at`` model the rejoin inside the plan itself and don't
+        need this."""
+        self._cache._bcast = self._orig
 
 
 def prefix_file_intact(path: str) -> bool:

@@ -27,6 +27,9 @@ Usage::
     python tools/run_tests.py --faults   # only the seeded fault-injection
                                          # tests (-m fault); they are fast
                                          # and also part of tier-1
+    python tools/run_tests.py --recovery # only the recovery-supervisor
+                                         # tests (-m recovery); fast,
+                                         # also tier-1
     python tools/run_tests.py --list     # show the shard plan only
 
 Prints a per-shard progress line and ONE aggregate summary; exits 0
@@ -152,12 +155,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--faults", action="store_true",
                     help="run only the seeded serving fault-injection "
                          "tests (forwards -m fault)")
+    ap.add_argument("--recovery", action="store_true",
+                    help="run only the recovery-supervisor tests "
+                         "(forwards -m recovery)")
     ap.add_argument("pytest_args", nargs="*",
                     help="extra args forwarded to pytest (e.g. -k expr)")
     args, unknown = ap.parse_known_args(argv)
     args.pytest_args = unknown + args.pytest_args
     if args.faults:
         args.pytest_args += ["-m", "fault"]
+    if args.recovery:
+        args.pytest_args += ["-m", "recovery"]
 
     counts = collect_counts(args.pytest_args)
     if not counts:
