@@ -319,6 +319,36 @@ def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
             cache.release(s)
         return elapsed
 
+    def run_overlap(cache) -> float:
+        """The overlapped (double-buffered) serving loop
+        (serving_overlap, SERVING.md rung 16): window N+1 is enqueued
+        on the device-resident carry BEFORE window N's tokens are
+        fetched, so N's harvest transfer and host-side processing hide
+        under N+1's device execution. Steps/s should approach
+        1/max(R, W*t) where the serial windowed leg pays
+        1/(R + W*t) per window — the win grows with the session's
+        relay RTT and vanishes (ratio -> 1) when R << W*t."""
+        tokens = _prefill_slots(cache, params, prompts)
+        start = time.perf_counter()
+        remaining = n_new
+        w = _floored_window(window, remaining)
+        inflight = cache.dispatch_window(params, tokens, w)
+        remaining -= w
+        while inflight is not None:
+            nxt = None
+            if remaining:
+                w = _floored_window(window, remaining)
+                nxt = cache.dispatch_window(params, None, w)
+                remaining -= w
+            # the serving loop emits these while the next window runs
+            np.asarray(cache.harvest_window(inflight))
+            inflight = nxt
+        elapsed = time.perf_counter() - start
+        cache.drop_carry()
+        for s in range(slots):
+            cache.release(s)
+        return elapsed
+
     def run_hostloop(cache) -> float:
         """Per-step dispatch WITH the per-step host read the serving
         loop performs (the r3-era sampled-slot path, kept as the
@@ -343,7 +373,9 @@ def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
     )
     best = _best_time(run_windowed, cache)
     best_host = _best_time(run_hostloop, cache)
-    return slots * n_new / best, n_new / best, n_new / best_host
+    best_overlap = _best_time(run_overlap, cache)
+    return (slots * n_new / best, n_new / best, n_new / best_host,
+            slots * n_new / best_overlap, best / best_overlap)
 
 
 def measure_paged_mixed(cfg, slots: int, prompt_len: int, n_new: int,
@@ -745,7 +777,8 @@ def main() -> int:
     decode_mha = measure_decode(mha, DECODE_BATCH, DECODE_PROMPT, DECODE_NEW)
     decode_gqa = measure_decode(gqa, DECODE_BATCH, DECODE_PROMPT, DECODE_NEW)
     relay_rtt_ms = measure_relay_rtt()
-    paged_tps, paged_sps, paged_host_sps = measure_paged_decode(
+    (paged_tps, paged_sps, paged_host_sps,
+     paged_overlap_tps, paged_overlap_speedup) = measure_paged_decode(
         gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE
     )
     spec_tps, plain_b1_tps, spec_accept = measure_speculative(
@@ -810,6 +843,21 @@ def main() -> int:
                 ),
                 "paged_decode_slots": PAGED_SLOTS,
                 "paged_decode_window": PAGED_WINDOW,
+                # Double-buffered window pipeline (serving_overlap,
+                # SERVING.md rung 16): window N+1 is enqueued on the
+                # device-resident carry before window N's tokens are
+                # read back, hiding the harvest round trip under
+                # device execution — steps/s approaches 1/max(R, W*t)
+                # vs the serial leg's 1/(R + W*t). The speedup is an
+                # RTT play: read it against relay_rtt_ms (expected
+                # >= 1.3x whenever RTT >= 20 ms; ~1.0x on a sub-ms
+                # local relay where W*t dominates).
+                "paged_decode_overlap_tokens_per_sec": round(
+                    paged_overlap_tps, 1
+                ),
+                "paged_decode_overlap_speedup": round(
+                    paged_overlap_speedup, 3
+                ),
                 # Batched speculative serving (serving_speculative=4)
                 # on the same favorable repetitive input as the
                 # single-row spec metrics: one verify pass advances

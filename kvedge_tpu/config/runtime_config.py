@@ -310,6 +310,15 @@ class RuntimeConfig:
     # next window boundary, so admission latency grows with the window
     # (SERVING.md's performance model). 1 = per-step dispatch.
     serving_window: int = 64
+    # Overlapped window dispatch for the paged backend: "auto"/"on"
+    # run the double-buffered decode loop (window N+1 is enqueued on a
+    # device-resident carry before window N is harvested, so host
+    # processing and the dispatch RTT hide under device execution —
+    # steps/s approaches 1/max(RTT, window*t_step) instead of
+    # 1/(RTT + window*t_step), SERVING.md rung 16); "off" keeps the
+    # serial windowed loop. Token streams are bit-identical either
+    # way. Price: one extra in-flight window of admission latency.
+    serving_overlap: str = "auto"
     # Server-wide speculative decoding for the paged backend: draft
     # length K (0 = off), or "auto". Greedy traffic advances by batched
     # verify passes — K prompt-lookup drafts per slot, up to K+1 tokens
@@ -474,6 +483,10 @@ class RuntimeConfig:
                 serving_window=int(
                     payload_doc.get("serving_window", cls.serving_window)
                 ),
+                serving_overlap=str(
+                    payload_doc.get("serving_overlap",
+                                    cls.serving_overlap)
+                ),
                 serving_speculative=_parse_speculative(
                     payload_doc.get("serving_speculative",
                                     cls.serving_speculative)
@@ -573,6 +586,11 @@ class RuntimeConfig:
                 "[payload] serving_window must be in [1, 1024] "
                 "(1 = per-step dispatch)"
             )
+        if self.serving_overlap not in ("auto", "on", "off"):
+            raise RuntimeConfigError(
+                "[payload] serving_overlap must be 'auto', 'on' or "
+                "'off'"
+            )
         if self.serving_speculative != "auto" and not (
             isinstance(self.serving_speculative, int)
             and 0 <= self.serving_speculative <= 16
@@ -670,6 +688,7 @@ class RuntimeConfig:
             "serving_prefix_persist = "
             f"{'true' if self.serving_prefix_persist else 'false'}\n"
             f"serving_window = {self.serving_window}\n"
+            f"serving_overlap = {s(self.serving_overlap)}\n"
             "serving_speculative = "
             f"{s(self.serving_speculative) if isinstance(self.serving_speculative, str) else self.serving_speculative}\n"
             f"serving_retry_after_s = {self.serving_retry_after_s}\n"

@@ -32,8 +32,6 @@ from __future__ import annotations
 import dataclasses
 import re
 
-from kvedge_tpu.version import CHART_NAME
-
 _DISK_SIZE_RE = re.compile(r"^[1-9][0-9]*(Ei|Pi|Ti|Gi|Mi|Ki|E|P|T|G|M|K)?$")
 # GKE TPU accelerator node-selector values are DNS-label-ish tokens.
 _ACCELERATOR_RE = re.compile(r"^[a-z0-9]([a-z0-9-]*[a-z0-9])?$")
@@ -49,9 +47,14 @@ class ChartValues:
     # Create a LoadBalancer service for external SSH/status access
     # (reference: aziotEdgeVmEnableExternalSsh, values.yaml:5).
     tpuRuntimeEnableExternalSsh: bool = True
-    # Resource-name prefix; defaults to the chart name and is truncated to 40
-    # chars by the name helper (reference: nameOverride, values.yaml:8).
-    nameOverride: str = CHART_NAME
+    # Resource-name prefix; empty ("" = unset, the reference's shipped
+    # default) falls back to the chart name via the name helper's `default`
+    # and is truncated to 40 chars either way (reference: nameOverride,
+    # values.yaml:8). Shipping "" rather than the chart name keeps the
+    # unset path — the one the reference's raw-.Values Secret ref broke
+    # (aziot-edge-vm.yaml:57, live TODO) — exercised by every default
+    # render; tests/test_names.py pins the fallback.
+    nameOverride: str = ""
     # SSH public key authorized inside the runtime pod
     # (reference: publicSshKey, values.yaml:11).
     publicSshKey: str = ""

@@ -200,6 +200,12 @@ class PagedKVCache:
         # finds the free list short asks the owner to reclaim pins
         # before failing. Signature: pressure_relief(needed) -> bool.
         self.pressure_relief = None
+        # Device-resident last-token carry for the overlap pipeline:
+        # (produced tokens [n, slots], n) of the most recent
+        # dispatch_window*. Window N+1's input row is carry[0][n-1] —
+        # sliced on device, so dispatching N+1 never forces N's result
+        # to the host.
+        self._carry = None
 
     def _init_state(self, shape, dtype) -> PagedState:
         """Fresh zeroed device state. The slice-serving subclass
@@ -619,6 +625,135 @@ class PagedKVCache:
         )
         return toks
 
+    # ---- overlapped (double-buffered) windows ---------------------------
+
+    def _window_caps(self, n_steps: int, steps_left) -> "np.ndarray":
+        import numpy as _np
+
+        if steps_left is None:
+            return _np.full((self.slots,), n_steps, _np.int32)
+        caps = _np.minimum(
+            _np.asarray(steps_left, _np.int64), n_steps
+        )
+        return _np.maximum(caps, 0).astype(_np.int32)
+
+    def dispatch_window(self, params, tokens, n_steps: int, active=None,
+                        steps_left=None):
+        """Enqueue a greedy decode window WITHOUT forcing its result.
+
+        The pipelined twin of :meth:`step_window`: returns the produced
+        tokens as an unforced device value (JAX async dispatch — the
+        program is queued, the host keeps running) to be forced later
+        with :meth:`harvest_window`. Because the device stream executes
+        in order, a second dispatch may be enqueued before the first is
+        harvested; ``tokens=None`` feeds the previous dispatch's final
+        token row (the device-resident carry), so no host round trip
+        separates back-to-back windows.
+
+        ``steps_left`` [slots] int32 is each row's remaining decode
+        budget (None = no cap): row b advances ``min(n_steps,
+        steps_left[b])`` steps and then freezes on device (see
+        :func:`_paged_decode_window_capped_impl`), which is what makes
+        a speculatively dispatched window safe. Pages and host lengths
+        advance by each row's TRUE advance, never the full window.
+        """
+        slots = self._step_slots(active)
+        caps = self._window_caps(n_steps, steps_left)
+        grew = False
+        for slot in slots:
+            if caps[slot] > 0:
+                grew |= self.grow_to(slot, int(caps[slot]))
+        if grew:
+            self._sync()
+        toks = self._device_window_dispatch(
+            params, tokens, n_steps, active, caps
+        )
+        for slot in slots:
+            self._host_lengths[slot] += int(caps[slot])
+        return toks
+
+    def dispatch_window_sampled(self, params, tokens, n_steps: int,
+                                active, key_data, base_steps, temps,
+                                top_ps, sampled_mask, steps_left=None):
+        """Mixed greedy/sampled :meth:`dispatch_window` (same carry,
+        cap, and growth discipline; sampling inputs as in
+        :meth:`step_window_sampled`)."""
+        slots = self._step_slots(active)
+        caps = self._window_caps(n_steps, steps_left)
+        grew = False
+        for slot in slots:
+            if caps[slot] > 0:
+                grew |= self.grow_to(slot, int(caps[slot]))
+        if grew:
+            self._sync()
+        toks = self._device_window_sampled_dispatch(
+            params, tokens, n_steps, active, key_data, base_steps,
+            temps, top_ps, sampled_mask, caps,
+        )
+        for slot in slots:
+            self._host_lengths[slot] += int(caps[slot])
+        return toks
+
+    def harvest_window(self, handle):
+        """Force a dispatched window's tokens to the host
+        ([n_steps, slots] int32). Blocks until the device finishes that
+        window — ideally while a later window is already queued behind
+        it (the overlap)."""
+        import numpy as _np
+
+        return _np.asarray(handle)
+
+    def _carry_tokens(self):
+        if self._carry is None:
+            raise PagedCacheError(
+                "no window in flight to carry tokens from — the first "
+                "window of a pipeline must pass explicit tokens"
+            )
+        toks, n = self._carry
+        return toks[n - 1]
+
+    def drop_carry(self) -> None:
+        """Forget the device-resident carry (recovery: a revived pool
+        restarts its pipeline from host tokens)."""
+        self._carry = None
+
+    def _device_window_dispatch(self, params, tokens, n_steps: int,
+                                active, steps_left):
+        """Device seam: enqueue a capped greedy window (no read)."""
+        import numpy as _np
+
+        toks_in = (self._carry_tokens() if tokens is None
+                   else jnp.asarray(_np.asarray(tokens, _np.int32)))
+        toks, self.state = _paged_decode_window_capped(
+            params, self.state, toks_in, self.cfg, n_steps,
+            self._active_array(self.state, active),
+            jnp.asarray(_np.asarray(steps_left, _np.int32)),
+        )
+        self._carry = (toks, n_steps)
+        return toks
+
+    def _device_window_sampled_dispatch(self, params, tokens,
+                                        n_steps: int, active, key_data,
+                                        base_steps, temps, top_ps,
+                                        sampled_mask, steps_left):
+        """Device seam: enqueue a capped mixed window (no read)."""
+        import numpy as _np
+
+        toks_in = (self._carry_tokens() if tokens is None
+                   else jnp.asarray(_np.asarray(tokens, _np.int32)))
+        toks, self.state = _paged_decode_window_sampled_capped(
+            params, self.state, toks_in, self.cfg, n_steps,
+            self._active_array(self.state, active),
+            jnp.asarray(_np.asarray(key_data, _np.uint32)),
+            jnp.asarray(_np.asarray(base_steps, _np.int32)),
+            jnp.asarray(_np.asarray(temps, _np.float32)),
+            jnp.asarray(_np.asarray(top_ps, _np.float32)),
+            jnp.asarray(_np.asarray(sampled_mask, bool)),
+            jnp.asarray(_np.asarray(steps_left, _np.int32)),
+        )
+        self._carry = (toks, n_steps)
+        return toks
+
     def step_spec(self, params, tokens, active, spec_mask):
         """One speculative verify pass (see :func:`_spec_verify_core`).
 
@@ -1026,6 +1161,82 @@ def _paged_decode_window_impl(params: dict, state: PagedState, tokens,
 _paged_decode_window = functools.partial(
     jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(1,)
 )(_paged_decode_window_impl)
+
+
+def _paged_decode_window_capped_impl(params: dict, state: PagedState,
+                                     tokens, cfg: TransformerConfig,
+                                     n_steps: int, active, steps_left):
+    """Greedy window with PER-SLOT stop detection in the scan carry.
+
+    The overlap pipeline (serving.py) dispatches window N+1 before the
+    host has harvested window N, so the host can no longer shrink the
+    window to the tightest slot's remaining budget the way the serial
+    path does (_window_steps). Instead each row carries its own budget
+    cap: ``steps_left`` [B] int32 is how many steps row b may still
+    decode, and the per-step done flag ``i >= steps_left[b]`` freezes a
+    finished row — its length holds and its K/V scatters drop (the
+    same ``active`` gate chunked prefill relies on), so a speculatively
+    dispatched window can never scribble past a stop the host hasn't
+    seen yet. A frozen row keeps re-emitting its final token; the host
+    truncates its stream at the true stop when it harvests
+    (row b's real tokens are produced[:steps_left[b]]).
+    """
+    def body(carry, i):
+        state, toks = carry
+        live = active & (i < steps_left)
+        logits, state = _decode_step_core(params, state, toks, cfg, live)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(live, nxt, toks)
+        return (state, nxt), nxt
+
+    (state, _), produced = jax.lax.scan(
+        body, (state, tokens), jnp.arange(n_steps)
+    )
+    return produced, state
+
+
+_paged_decode_window_capped = functools.partial(
+    jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(1,)
+)(_paged_decode_window_capped_impl)
+
+
+def _paged_decode_window_sampled_capped_impl(
+        params: dict, state: PagedState, tokens,
+        cfg: TransformerConfig, n_steps: int, active, key_data,
+        base_steps, temps, top_ps, sampled_mask, steps_left):
+    """Mixed greedy/sampled window with the per-slot done flag of
+    :func:`_paged_decode_window_capped_impl`. Live rows run the exact
+    key schedule of the serial sampled window (``fold_in(seed,
+    base + i)``), so pipelined and serial sampled decode emit identical
+    tokens; frozen rows' draws are computed and discarded (their
+    outputs are never read and their state never advances)."""
+    keys = jax.random.wrap_key_data(key_data)
+
+    def body(carry, i):
+        state, toks = carry
+        live = active & (i < steps_left)
+        logits, state = _decode_step_core(params, state, toks, cfg,
+                                          live)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        from kvedge_tpu.models.decode import sample_token
+
+        step_keys = jax.vmap(jax.random.fold_in)(keys, base_steps + i)
+        sampled = sample_token(
+            logits, step_keys, temps[:, None], top_ps[:, None]
+        )
+        nxt = jnp.where(sampled_mask, sampled, greedy).astype(jnp.int32)
+        nxt = jnp.where(live, nxt, toks)
+        return (state, nxt), nxt
+
+    (state, _), produced = jax.lax.scan(
+        body, (state, tokens), jnp.arange(n_steps)
+    )
+    return produced, state
+
+
+_paged_decode_window_sampled_capped = functools.partial(
+    jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(1,)
+)(_paged_decode_window_sampled_capped_impl)
 
 
 def _paged_decode_window_sampled_impl(params: dict, state: PagedState,

@@ -24,6 +24,7 @@ capability the repo's own README listed as future work.
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import queue
 import threading
@@ -39,6 +40,33 @@ from kvedge_tpu.runtime.failures import (
 
 # Stream sentinel objects (token queue carries ints, then one of these).
 _STREAM_DONE = object()
+
+
+class _Hist:
+    """Fixed-bucket histogram in Prometheus shape: ``edges`` are ``le``
+    upper bounds, counts are stored PER bucket (last slot = +Inf) and
+    cumulated at render time (runtime/status.py), so one observation
+    touches one counter. Mutated only under the server lock; snapshots
+    copy plain ints/floats."""
+
+    __slots__ = ("edges", "counts", "total", "n")
+
+    def __init__(self, edges: tuple):
+        self.edges = tuple(float(e) for e in edges)
+        self.counts = [0] * (len(self.edges) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        # bisect_left: v == edge lands IN that edge's bucket (le means
+        # "less than or equal", the Prometheus boundary convention).
+        self.counts[bisect.bisect_left(self.edges, v)] += 1
+        self.total += v
+        self.n += 1
+
+    def snapshot(self) -> dict:
+        return {"edges": list(self.edges), "counts": list(self.counts),
+                "sum": self.total, "count": self.n}
 
 
 def _raw_key_data(key) -> np.ndarray:
@@ -107,6 +135,13 @@ class _Request:
     # Cancellation request (consumer gone / explicit): honored at the
     # next loop iteration — the step/window in flight completes first.
     cancelled: bool = False
+    # Overlap pipeline bookkeeping: tokens this request will receive
+    # from windows that are DISPATCHED but not yet harvested.
+    # len(generated) + inflight is the request's committed position —
+    # the number the next window's budget cap is computed from, so a
+    # speculative dispatch can never outrun the budget even though the
+    # host hasn't seen its tokens yet.
+    inflight: int = 0
 
     def pick(self, logits_row, step: int) -> int:
         """Next token from a [V] logits row, greedy or sampled. Used at
@@ -177,7 +212,8 @@ class PagedGenerationServer:
                  prefill_chunk: int = 0, prefix_cache: bool = True,
                  speculative: int = 0, window: int = 64,
                  kv_dtype: str = "", cache=None,
-                 retry_after_s: float | None = None):
+                 retry_after_s: float | None = None,
+                 overlap: str = "auto"):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
@@ -196,6 +232,35 @@ class PagedGenerationServer:
         if window < 1:
             raise ValueError("window must be >= 1")
         self._window = window
+        # Overlapped (double-buffered) window dispatch ([payload]
+        # serving_overlap): the decode loop enqueues window N+1 before
+        # harvesting window N, so the host's round trip and bookkeeping
+        # for N hide under the device's execution of N+1 — steps/s
+        # moves from 1/(R + W*t) toward 1/max(R, W*t) (SERVING.md
+        # rung 16). "auto" and "on" both pipeline (the loop itself
+        # falls back to non-overlapped boundaries whenever exactness
+        # needs one: admissions, cancellations, speculative passes);
+        # "off" keeps the serial loop verbatim.
+        if overlap not in ("auto", "on", "off"):
+            raise ValueError("overlap must be 'auto', 'on' or 'off'")
+        self._overlap = overlap
+        self._overlap_on = overlap != "off"
+        # The one in-flight (dispatched, unharvested) window record:
+        # {"window": steps, "parts": [(slot, req, adv)], "handle":
+        # unforced device tokens, "t0": dispatch stamp}. Depth is at
+        # most 1 — double buffering, not an unbounded queue — so the
+        # admission-latency price is bounded at one extra window.
+        self._inflight: dict | None = None
+        self._overlap_windows = 0
+        # Per-window latency histograms (ms; exported via /metrics):
+        # dispatch->harvest wall time (the device+RTT leg), host
+        # processing time (the work the overlap hides), and the
+        # pipeline depth observed at each dispatch.
+        self._hist_rtt = _Hist((1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+                                100.0, 200.0, 500.0, 1000.0, 2000.0))
+        self._hist_host = _Hist((0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0,
+                                 20.0, 50.0, 100.0))
+        self._hist_depth = _Hist((0.0, 1.0))
         # Speculative mode (draft length K, 0 = off): greedy slots
         # advance by batched verify passes — K prompt-lookup drafts per
         # slot, one (1+K)-query forward for the whole batch, up to K+1
@@ -1083,6 +1148,12 @@ class PagedGenerationServer:
             self._free_slots = list(range(self._cache.slots))[::-1]
             self._reserved = 0
             self._active.clear()
+            # The failing loop drained its in-flight window before
+            # poisoning; clear defensively and forget the device
+            # carry — a revived pipeline restarts from host tokens
+            # (a slice cache's reform() already dropped its own).
+            self._inflight = None
+            self._cache.drop_carry()
             self._poison = None
             self._degraded_reason = None
             self._closed = False
@@ -1107,6 +1178,16 @@ class PagedGenerationServer:
                 "prefix_entries": len(self._prefix_entry_nodes),
                 "prefix_hits": self._prefix_hits,
                 "prefix_tokens_saved": self._prefix_tokens_saved,
+                "overlap": 1 if self._overlap_on else 0,
+                "overlap_windows_total": self._overlap_windows,
+                "overlap_inflight_depth":
+                    1 if self._inflight is not None else 0,
+                # Histogram snapshots (dict-valued; status.py renders
+                # them as Prometheus histograms, scalar consumers
+                # should skip them).
+                "window_dispatch_harvest_ms": self._hist_rtt.snapshot(),
+                "window_host_ms": self._hist_host.snapshot(),
+                "window_inflight_depth": self._hist_depth.snapshot(),
             }
             if self._degraded_reason:
                 out["degraded_reason"] = self._degraded_reason
@@ -1342,9 +1423,44 @@ class PagedGenerationServer:
         ))
         return {s: int(picked[i]) for i, s in enumerate(slots)}
 
+    def _sweep_cancelled_locked(self) -> None:
+        """Cancelled requests leave at a boundary: slot and pages
+        return to the pool, the waiter (if any) gets RequestCancelled.
+        Runs before the finish-sweep so a cancel that raced budget
+        completion still wins — the consumer is gone either way."""
+        for slot in list(self._active):
+            req = self._active[slot]
+            if not req.cancelled:
+                continue
+            del self._active[slot]
+            self._release_locked(slot, self._pages_for(req))
+            req.error = RequestCancelled(
+                "request cancelled mid-decode"
+            )
+            if req.stream is not None:
+                req.stream.put(req.error)
+            req.done.set()
+
+    def _sweep_finished_locked(self) -> None:
+        """A request whose pending token completes its budget needs no
+        step at all (the token is already known) — finish it before
+        the batch, the same discipline as generate()'s n_new - 1
+        decode steps."""
+        for slot in list(self._active):
+            req = self._active[slot]
+            if len(req.generated) + 1 >= req.n_new:
+                self._emit(req, req.next_token)
+                del self._active[slot]
+                self._release_locked(slot, self._pages_for(req))
+                if req.stream is not None:
+                    req.stream.put(_STREAM_DONE)
+                req.done.set()
+
     def _loop(self) -> None:
+        step = (self._loop_once_overlap if self._overlap_on
+                else self._loop_once)
         while True:
-            if self._loop_once() == "exit":
+            if step() == "exit":
                 if self._poison is not None:
                     self._degrade()  # outside the lock, loop exited
                 return
@@ -1382,37 +1498,8 @@ class PagedGenerationServer:
                 self._active.clear()
                 return "exit"
             try:
-                # Cancelled requests leave at this boundary: slot and
-                # pages return to the pool, the waiter (if any) gets
-                # RequestCancelled. Before the finish-check so a
-                # cancel that raced budget completion still wins —
-                # the consumer is gone either way.
-                for slot in list(self._active):
-                    req = self._active[slot]
-                    if not req.cancelled:
-                        continue
-                    del self._active[slot]
-                    self._release_locked(slot, self._pages_for(req))
-                    req.error = RequestCancelled(
-                        "request cancelled mid-decode"
-                    )
-                    if req.stream is not None:
-                        req.stream.put(req.error)
-                    req.done.set()
-                # A request whose pending token completes its budget
-                # needs no step at all (the token is already known) —
-                # finish it before the batch, the same discipline as
-                # generate()'s n_new - 1 decode steps.
-                for slot in list(self._active):
-                    req = self._active[slot]
-                    if len(req.generated) + 1 >= req.n_new:
-                        self._emit(req, req.next_token)
-                        del self._active[slot]
-                        self._release_locked(slot,
-                                             self._pages_for(req))
-                        if req.stream is not None:
-                            req.stream.put(_STREAM_DONE)
-                        req.done.set()
+                self._sweep_cancelled_locked()
+                self._sweep_finished_locked()
                 if not self._active:
                     return "ran"
                 if (self._spec > 0
@@ -1484,3 +1571,249 @@ class PagedGenerationServer:
                 self._poison_locked(classify_failure(e))
                 return "exit"
         return "ran"
+
+    # ---- overlapped decode loop ------------------------------------------
+
+    def _loop_once_overlap(self) -> str:
+        """One iteration of the double-buffered decode loop.
+
+        Two alternating shapes. At a NON-OVERLAPPED BOUNDARY
+        (``_inflight is None``) it reconciles exactly like the serial
+        loop — cancel sweep, finish sweep, admissions implicitly via
+        ``_active``, speculative passes — then DISPATCHES a window
+        without harvesting it. With a window IN FLIGHT it first
+        enqueues the next window on the device-resident carry (no host
+        round trip between the two — this is the overlap), then
+        harvests and processes the previous window's tokens while the
+        next one runs. Whenever exactness needs a boundary (a cancel
+        arrived, a newcomer admitted, budgets exhausted) it harvests
+        WITHOUT dispatching, so the next iteration reconciles serially.
+
+        A speculatively dispatched window can never corrupt state: each
+        row's device-side ``steps_left`` cap freezes it at its true
+        budget (frozen rows stop scattering K/V and stop advancing
+        length — kvcache._paged_decode_window_capped_impl), and the
+        host truncates each row's emitted stream at its own cap.
+        """
+        with self._work:
+            while (not self._active and self._inflight is None
+                   and not self._closed
+                   and not (self._draining
+                            and not self._prefilling)):
+                self._work.wait()
+            if (self._draining and not self._active
+                    and self._inflight is None
+                    and not self._prefilling):
+                return "exit"
+            if self._closed:
+                # Hard close: abandon the in-flight window unforced
+                # (the device finishes it harmlessly; never block a
+                # close on a potentially dead op stream) and fail the
+                # waiters, as in the serial loop.
+                rec, self._inflight = self._inflight, None
+                if rec is not None:
+                    for _, req, adv in rec["parts"]:
+                        req.inflight -= adv
+                for req in self._active.values():
+                    req.error = ServerClosed("server shut down mid-"
+                                             "request")
+                    if req.stream is not None:
+                        req.stream.put(req.error)
+                    req.done.set()
+                self._active.clear()
+                return "exit"
+            try:
+                if self._inflight is None:
+                    self._sweep_cancelled_locked()
+                    self._sweep_finished_locked()
+                    if not self._active:
+                        return "ran"
+                    if (self._spec > 0
+                            and any(req.sampling is None
+                                    for req in self._active.values())):
+                        # Speculative passes need the host between
+                        # every device call (drafting reads emitted
+                        # tokens) — they run at boundaries only and
+                        # never overlap.
+                        self._spec_pass()
+                        return "ran"
+                    self._inflight = self._dispatch_window_locked(
+                        first=True
+                    )
+                    return "ran"
+                prev, self._inflight = self._inflight, None
+                try:
+                    if not self._boundary_wanted_locked(prev):
+                        # Enqueue N+1 on the carry BEFORE touching
+                        # N's result — the device starts N+1 the
+                        # moment N retires, while the host is still
+                        # in _harvest_locked below.
+                        self._inflight = self._dispatch_window_locked(
+                            first=False
+                        )
+                    self._harvest_locked(prev)
+                except Exception:
+                    # prev was not reconciled — restore its inflight
+                    # accounting and drain it with whatever else is
+                    # queued, then poison below.
+                    self._drain_rec_locked(prev)
+                    raise
+            except Exception as e:
+                # Poison path: drain the in-flight window FIRST so
+                # recovery (revive/reform) never races a queued device
+                # program, then fail every waiter loudly.
+                self._drain_inflight_locked()
+                self._poison_locked(classify_failure(e))
+                return "exit"
+        return "ran"
+
+    def _boundary_wanted_locked(self, prev: dict) -> bool:
+        """Should the pipeline fall back to a non-overlapped boundary
+        instead of dispatching the next window? Yes when a cancel must
+        be honored, or when a slot is active that the in-flight window
+        never dispatched (a newcomer admission — it may only join at a
+        boundary, where its first token is host-known; the carry row
+        of a slot that sat out the previous window is garbage)."""
+        dispatched = {slot for slot, _, _ in prev["parts"]}
+        for slot, req in self._active.items():
+            if req.cancelled or slot not in dispatched:
+                return True
+        return False
+
+    def _dispatch_window_locked(self, first: bool) -> dict | None:
+        """Enqueue one capped window for every active slot with budget
+        remaining (lock held); returns the in-flight record, or None
+        when no slot can advance.
+
+        ``first`` distinguishes the boundary dispatch (explicit
+        host-known pending tokens) from the overlapped dispatch
+        (``tokens=None`` — the cache feeds the previous window's final
+        token row, still resident on device). The per-row cap is
+        ``n_new - len(generated) - inflight - 1``: committed position
+        plus the pending token the finish-check emits stepless, so a
+        speculative window can never decode past a budget the host
+        has not reconciled yet. A row whose previous window froze it
+        early always reaches cap 0 here and sits the window out.
+        """
+        parts = []
+        for slot, req in self._active.items():
+            cap = req.n_new - len(req.generated) - req.inflight - 1
+            if cap > 0:
+                parts.append((slot, req, cap))
+        if not parts:
+            return None
+        # The widest remaining budget sets the window (pow2-floored,
+        # same compiled-program set as the serial path): rows with
+        # less budget freeze mid-window on device instead of dragging
+        # every co-tenant down to the tightest budget.
+        w = min(self._window, max(cap for _, _, cap in parts))
+        if w > 1:
+            w = 1 << (w.bit_length() - 1)
+        n = self._cache.slots
+        tokens = np.zeros((n,), np.int32)
+        mask = np.zeros((n,), bool)
+        steps_left = np.zeros((n,), np.int32)
+        recs = []
+        for slot, req, cap in parts:
+            adv = min(w, cap)
+            tokens[slot] = req.next_token
+            mask[slot] = True
+            steps_left[slot] = adv
+            recs.append((slot, req, adv))
+        samplers = {slot: req for slot, req, _ in parts
+                    if req.sampling is not None}
+        tok_arg = tokens if first else None
+        if samplers:
+            key_data = np.zeros(
+                (n,) + self._key_data_shape(samplers), np.uint32
+            )
+            base_steps = np.zeros((n,), np.int32)
+            temps = np.ones((n,), np.float32)
+            top_ps = np.ones((n,), np.float32)
+            smask = np.zeros((n,), bool)
+            for slot, req in samplers.items():
+                key_data[slot] = req.key_data
+                # Committed position: the serial schedule's
+                # len(generated)+1 with the unharvested advance
+                # folded in, so token t still samples with
+                # fold_in(seed, t) regardless of pipelining.
+                base_steps[slot] = (len(req.generated)
+                                    + req.inflight + 1)
+                temps[slot] = float(req.sampling[1])
+                top_ps[slot] = float(req.sampling[2])
+                smask[slot] = True
+            handle = self._cache.dispatch_window_sampled(
+                self._params, tok_arg, w, mask, key_data, base_steps,
+                temps, top_ps, smask, steps_left=steps_left,
+            )
+        else:
+            handle = self._cache.dispatch_window(
+                self._params, tok_arg, w, active=mask,
+                steps_left=steps_left,
+            )
+        for _, req, adv in recs:
+            req.inflight += adv
+        self._hist_depth.observe(0.0 if first else 1.0)
+        return {"window": w, "parts": recs, "handle": handle,
+                "t0": time.perf_counter()}
+
+    def _harvest_locked(self, rec: dict) -> None:
+        """Force an in-flight window's tokens and reconcile (lock
+        held): emission, budget finishes, carry of the new pending
+        token. Each row's stream truncates at its own dispatch-time
+        cap (``adv``) — rows past their cap were frozen on device and
+        their produced entries merely repeat the last live token."""
+        produced = np.asarray(self._cache.harvest_window(rec["handle"]))
+        self._hist_rtt.observe(
+            (time.perf_counter() - rec["t0"]) * 1e3
+        )
+        t_host = time.perf_counter()
+        rec["counted"] = True
+        for _, req, adv in rec["parts"]:
+            req.inflight -= adv
+        for slot, req, adv in rec["parts"]:
+            if self._active.get(slot) is not req:
+                # Released while in flight (hard-close/cancel races
+                # resolve at boundaries, so normally unreachable) —
+                # nothing to emit into.
+                continue
+            self._emit(req, req.next_token)
+            for i in range(adv - 1):
+                self._emit(req, int(produced[i, slot]))
+            req.next_token = int(produced[adv - 1, slot])
+            if (len(req.generated) + 1 >= req.n_new
+                    and not req.cancelled):
+                # Inline finish: with the pipeline saturated the loop
+                # may never visit a boundary, so a filled budget must
+                # complete here. The cancelled guard preserves the
+                # serial cancel-beats-finish order — the cancel sweep
+                # at the forced boundary takes it.
+                self._emit(req, req.next_token)
+                del self._active[slot]
+                self._release_locked(slot, self._pages_for(req))
+                if req.stream is not None:
+                    req.stream.put(_STREAM_DONE)
+                req.done.set()
+        self._overlap_windows += 1
+        self._hist_host.observe((time.perf_counter() - t_host) * 1e3)
+
+    def _drain_rec_locked(self, rec: dict | None) -> None:
+        """Unwind one in-flight record on the failure path: restore
+        the inflight counters and block (deadline-bounded for a slice
+        cache; its runner is dead-latched after a failure and returns
+        immediately) until the device has retired the window, so
+        recovery never tears down state a queued program still
+        writes."""
+        if rec is None:
+            return
+        if not rec.get("counted"):
+            for _, req, adv in rec["parts"]:
+                req.inflight -= adv
+        try:
+            self._cache.harvest_window(rec["handle"])
+        except Exception:
+            pass
+
+    def _drain_inflight_locked(self) -> None:
+        rec, self._inflight = self._inflight, None
+        self._drain_rec_locked(rec)

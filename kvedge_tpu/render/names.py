@@ -11,9 +11,12 @@ Reference: ``deployment/helm/templates/_helper.tpl``:
 One deliberate divergence, documented per SURVEY.md §7 hard-part (d): the
 reference references its cloud-init Secret by raw ``.Values.nameOverride``
 (``aziot-edge-vm.yaml:57``, with a live TODO) so an unset ``nameOverride``
-would render a Secret name the VM never finds. kvedge-tpu routes *every*
-resource name through :func:`resource_name`, fixing that latent mismatch;
-``tests/test_names.py`` pins the empty-``nameOverride`` case.
+would render a Secret name the VM never finds. kvedge-tpu closes that TODO
+at both layers: every resource name routes through :func:`resource_name`
+(so empty always falls back to the chart name), and the shipped default is
+``nameOverride: ""`` — the unset path is what every default install and
+render actually runs, not an untested corner. ``tests/test_names.py`` pins
+the unset-default rendering.
 """
 
 from __future__ import annotations

@@ -67,15 +67,22 @@ from kvedge_tpu.models.kvcache import (
     PagedKVCache,
     PagedState,
     _decode_step_core,
+    _paged_decode_window_capped_impl,
     _paged_decode_window_impl,
+    _paged_decode_window_sampled_capped_impl,
     _paged_decode_window_sampled_impl,
     _paged_prefill_impl,
     _spec_verify_core,
 )
 
-# Op codes (header[0]). STOP ends the follower loop.
+# Op codes (header[0]). STOP ends the follower loop. WINDOWP/WSAMPLEP
+# are the pipelined (overlap) window pair: dispatched WITHOUT reading
+# the result, so the leader can broadcast window N+1 while window N is
+# still executing — followers likewise replay the dispatch and never
+# block on a result (they never read tokens at all). New codes append
+# at the end: the numbering is wire protocol.
 (OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW, OP_SPEC,
- OP_WSAMPLE) = range(7)
+ OP_WSAMPLE, OP_WINDOWP, OP_WSAMPLEP) = range(9)
 _HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
 
 
@@ -132,7 +139,18 @@ def _slice_kernels(mesh, cfg, quantized: bool = False):
         static_argnames=("cfg", "n_steps"), donate_argnums=(1,),
         out_shardings=(rep, state_sh),
     )
-    return rep, state_sh, prefill, step, window, spec, wsample
+    window_capped = jax.jit(
+        _paged_decode_window_capped_impl,
+        static_argnames=("cfg", "n_steps"), donate_argnums=(1,),
+        out_shardings=(rep, state_sh),
+    )
+    wsample_capped = jax.jit(
+        _paged_decode_window_sampled_capped_impl,
+        static_argnames=("cfg", "n_steps"), donate_argnums=(1,),
+        out_shardings=(rep, state_sh),
+    )
+    return (rep, state_sh, prefill, step, window, spec, wsample,
+            window_capped, wsample_capped)
 
 
 class SlicePagedKVCache(PagedKVCache):
@@ -168,8 +186,8 @@ class SlicePagedKVCache(PagedKVCache):
         cfg = dataclasses.replace(cfg, paged_attention="gather")
         self.mesh = mesh
         (self._rep, self._state_sh, self._k_prefill, self._k_step,
-         self._k_window, self._k_spec,
-         self._k_wsample) = _slice_kernels(
+         self._k_window, self._k_spec, self._k_wsample,
+         self._k_window_capped, self._k_wsample_capped) = _slice_kernels(
              mesh, cfg, quantized=kv_dtype == "int8"
          )
         self._is_leader = jax.process_index() == 0
@@ -411,6 +429,109 @@ class SlicePagedKVCache(PagedKVCache):
         )
         return self._read(toks)
 
+    # ---- pipelined (overlap) window pair --------------------------------
+
+    def _device_window_dispatch(self, params, tokens, n_steps: int,
+                                active, steps_left):
+        """Leader: broadcast + enqueue a capped window WITHOUT reading
+        the result. ``tokens=None`` selects the device-resident carry
+        (header flag ``b``) — the previous window's final token row,
+        which every process slices locally from its own replicated
+        copy, so neither the leader nor any follower blocks on the
+        previous window between the pair. A zero placeholder still
+        rides the broadcast so the payload shape is op-independent."""
+        self._check_live()
+        carry = 0 if tokens is not None else 1
+        tokens_np = (np.zeros((self.slots,), np.int32) if carry
+                     else np.asarray(tokens, np.int32))
+        mask = self._active_np(active)
+        caps = np.asarray(steps_left, np.int32)
+
+        def op():
+            self._send_header(OP_WINDOWP, n_steps, carry)
+            sent, m, sl = self._bcast((tokens_np, mask, caps))
+            return self._exec_window_pipelined(
+                params, np.asarray(sent), np.asarray(m),
+                np.asarray(sl), n_steps=n_steps, carry=bool(carry),
+            )
+
+        return self._ops.run(("windowp", n_steps), op)
+
+    def _exec_window_pipelined(self, params, tokens: np.ndarray,
+                               mask: np.ndarray, caps: np.ndarray, *,
+                               n_steps: int, carry: bool):
+        toks_in = (self._carry_tokens() if carry
+                   else self._global(tokens.astype(np.int32)))
+        toks, self.state = self._k_window_capped(
+            params, self.state, toks_in, self.cfg, n_steps,
+            self._global(mask.astype(bool)),
+            self._global(caps.astype(np.int32)),
+        )
+        self._carry = (toks, n_steps)
+        return toks
+
+    def _device_window_sampled_dispatch(self, params, tokens,
+                                        n_steps: int, active, key_data,
+                                        base_steps, temps, top_ps,
+                                        sampled_mask, steps_left):
+        self._check_live()
+        carry = 0 if tokens is not None else 1
+        tokens_np = (np.zeros((self.slots,), np.int32) if carry
+                     else np.asarray(tokens, np.int32))
+        key_data = np.asarray(key_data, np.uint32)
+        mask = self._active_np(active)
+
+        def op():
+            # a = n_steps, b = key-data width, c = carry flag.
+            self._send_header(OP_WSAMPLEP, n_steps, key_data.shape[1],
+                              carry)
+            payload = self._bcast((
+                tokens_np, mask, key_data,
+                np.asarray(base_steps, np.int32),
+                np.asarray(temps, np.float32),
+                np.asarray(top_ps, np.float32),
+                np.asarray(sampled_mask, bool),
+                np.asarray(steps_left, np.int32),
+            ))
+            return self._exec_window_sampled_pipelined(
+                params, *(np.asarray(x) for x in payload),
+                n_steps=n_steps, carry=bool(carry),
+            )
+
+        return self._ops.run(("wsamplep", n_steps), op)
+
+    def _exec_window_sampled_pipelined(self, params, tokens, mask,
+                                       key_data, base_steps, temps,
+                                       top_ps, smask, caps, *,
+                                       n_steps: int, carry: bool):
+        toks_in = (self._carry_tokens() if carry
+                   else self._global(tokens.astype(np.int32)))
+        toks, self.state = self._k_wsample_capped(
+            params, self.state, toks_in, self.cfg, n_steps,
+            self._global(mask.astype(bool)),
+            self._global(key_data.astype(np.uint32)),
+            self._global(base_steps.astype(np.int32)),
+            self._global(temps.astype(np.float32)),
+            self._global(top_ps.astype(np.float32)),
+            self._global(smask.astype(bool)),
+            self._global(caps.astype(np.int32)),
+        )
+        self._carry = (toks, n_steps)
+        return toks
+
+    def harvest_window(self, handle):
+        """Leader: force a dispatched window's tokens. Deadline-bounded
+        like every op, but NOT a broadcast — the tokens are replicated,
+        every process already holds (or will hold, once its queued
+        program runs) its own copy, and followers never read them. The
+        read waits on device execution of everything queued up to and
+        including this window — i.e. the in-flight pair — so it runs
+        under the op budget rather than a bare timeout: the window
+        programs were compiled at dispatch, and the steady budget is
+        sized for device execution, not compilation."""
+        self._check_live()
+        return self._ops.run(("wharvest",), lambda: self._read(handle))
+
     def _device_spec(self, params, tokens, active, spec_mask):
         self._check_live()
         tokens = np.asarray(tokens, np.int32)
@@ -496,6 +617,11 @@ class SlicePagedKVCache(PagedKVCache):
             name="kvedge-slice-ops",
         )
         old.shutdown()
+        # Any in-flight pipelined window died with the old stream; the
+        # revived serving loop restarts from host tokens (its first
+        # dispatch is never a carry), so the stale device carry must
+        # not survive into the new stream.
+        self.drop_carry()
         tables = np.asarray(self._host_tables, np.int32)
         lengths = np.asarray(self._host_lengths, np.int32)
 
@@ -572,6 +698,36 @@ class SlicePagedKVCache(PagedKVCache):
             ))
             self._exec_spec(params, np.asarray(tokens),
                             np.asarray(mask), np.asarray(smask))
+        elif op == OP_WINDOWP:
+            # a = n_steps, b = carry flag. The dispatch-only replay:
+            # the follower enqueues the same program and moves on —
+            # it must not block on the previous window's result, or
+            # the leader's overlap would re-serialize at each host.
+            tokens, mask, caps = self._bcast((
+                np.zeros((self.slots,), np.int32),
+                np.zeros((self.slots,), bool),
+                np.zeros((self.slots,), np.int32),
+            ))
+            self._exec_window_pipelined(
+                params, np.asarray(tokens), np.asarray(mask),
+                np.asarray(caps), n_steps=a, carry=bool(b),
+            )
+        elif op == OP_WSAMPLEP:
+            # a = n_steps, b = key-data width, c = carry flag.
+            payload = self._bcast((
+                np.zeros((self.slots,), np.int32),
+                np.zeros((self.slots,), bool),
+                np.zeros((self.slots, b), np.uint32),
+                np.zeros((self.slots,), np.int32),
+                np.zeros((self.slots,), np.float32),
+                np.zeros((self.slots,), np.float32),
+                np.zeros((self.slots,), bool),
+                np.zeros((self.slots,), np.int32),
+            ))
+            self._exec_window_sampled_pipelined(
+                params, *(np.asarray(x) for x in payload), n_steps=a,
+                carry=bool(c),
+            )
         else:  # pragma: no cover - protocol corruption is slice-fatal
             raise PagedCacheError(f"unknown slice-serve op {op}")
         return True

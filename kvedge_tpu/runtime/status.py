@@ -95,6 +95,17 @@ _SERVE_METRIC_FIELDS = (
     ("window", "serve_window", "gauge",
      "device decode window cap in steps (paged backend, "
      "serving_window)"),
+    # Overlapped window pipeline (serving_overlap): whether the
+    # double-buffered decode loop is active, how many windows it has
+    # harvested, and whether one is in flight right now.
+    ("overlap", "serve_overlap", "gauge",
+     "1 if the overlapped (double-buffered) window pipeline is "
+     "enabled (paged backend, serving_overlap)"),
+    ("overlap_windows_total", "serve_overlap_windows_total", "counter",
+     "decode windows harvested by the overlapped pipeline"),
+    ("overlap_inflight_depth", "serve_overlap_inflight_depth", "gauge",
+     "dispatched-but-unharvested windows right now (0 or 1 — the "
+     "pipeline is double-buffered, never deeper)"),
     ("spec_passes", "serve_spec_passes_total", "counter",
      "speculative verify passes run (paged backend, "
      "serving_speculative > 0)"),
@@ -127,6 +138,41 @@ _SERVE_METRIC_FIELDS = (
      "wall-clock seconds the most recent successful recovery took "
      "(also the basis of the degraded-refusal retry-after hint)"),
 )
+
+# Per-window latency histograms from the overlapped decode loop
+# (models/serving.py _Hist snapshots: {"edges", "counts", "sum",
+# "count"} with per-bucket counts — cumulated into Prometheus ``le``
+# buckets here, at render time).
+_SERVE_HISTOGRAM_FIELDS = (
+    # (serving key, metric suffix, help text)
+    ("window_dispatch_harvest_ms", "serve_window_dispatch_harvest_ms",
+     "per-window dispatch-to-harvest wall time in ms (the device "
+     "execution + host-device RTT leg the pipeline overlaps)"),
+    ("window_host_ms", "serve_window_host_ms",
+     "per-window host processing time in ms (emission, stops, "
+     "bookkeeping — the work hidden under the next window)"),
+    ("window_inflight_depth", "serve_window_inflight_depth",
+     "pipeline depth observed at each window dispatch (0 = boundary "
+     "dispatch, 1 = overlapped dispatch)"),
+)
+
+
+def _render_histogram(lines: list, name: str, help_text: str,
+                      hist: dict) -> None:
+    edges = hist.get("edges") or []
+    counts = hist.get("counts") or []
+    if len(counts) != len(edges) + 1:
+        return  # malformed snapshot; skip rather than lie
+    lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} histogram")
+    cum = 0
+    for edge, count in zip(edges, counts):
+        cum += count
+        lines.append(f'{name}_bucket{{le="{edge:g}"}} {cum}')
+    cum += counts[-1]
+    lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+    lines.append(f"{name}_sum {hist.get('sum', 0)}")
+    lines.append(f"{name}_count {hist.get('count', 0)}")
 
 
 def render_metrics(snapshot: dict) -> str:
@@ -181,6 +227,10 @@ def render_metrics(snapshot: dict) -> str:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name} {value}")
+    for key, suffix, help_text in _SERVE_HISTOGRAM_FIELDS:
+        hist = serving.get(key)
+        if isinstance(hist, dict):
+            _render_histogram(lines, f"kvedge_{suffix}", help_text, hist)
     return "\n".join(lines) + "\n"
 
 

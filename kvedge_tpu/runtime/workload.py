@@ -1244,6 +1244,13 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 kv_dtype=cfg.serving_kv_dtype,
                 cache=cache,
                 retry_after_s=cfg.serving_retry_after_s,
+                # Overlapped window pipeline ([payload]
+                # serving_overlap). Multi-host note: revive() after a
+                # recovery restarts _loop, which re-selects the
+                # pipelined body — the slice cache's reform() dropped
+                # its device carry, so the revived pipeline re-enters
+                # cleanly from host tokens on every recovery cycle.
+                overlap=cfg.serving_overlap,
             )
             # Degraded-mode observability: when the pool poisons
             # (runtime/failures.py), persist a post-mortem failure

@@ -169,6 +169,32 @@ class FaultyCache(PagedKVCache):
         self._seam("spec")
         return super()._device_spec(params, tokens, active, spec_mask)
 
+    # Overlapped-pipeline seams (models/serving.py _loop_once_overlap):
+    # dispatch and harvest are SEPARATE failure boundaries now — a
+    # dispatch can die while an earlier window is still in flight, and
+    # a harvest can die on a window that was dispatched healthy. Both
+    # must drain cleanly into the poison path.
+    def _device_window_dispatch(self, params, tokens, n_steps: int,
+                                active, steps_left):
+        self._seam(f"windowp[{n_steps}]")
+        return super()._device_window_dispatch(
+            params, tokens, n_steps, active, steps_left
+        )
+
+    def _device_window_sampled_dispatch(self, params, tokens,
+                                        n_steps: int, active, key_data,
+                                        base_steps, temps, top_ps,
+                                        sampled_mask, steps_left):
+        self._seam(f"wsamplep[{n_steps}]")
+        return super()._device_window_sampled_dispatch(
+            params, tokens, n_steps, active, key_data, base_steps,
+            temps, top_ps, sampled_mask, steps_left,
+        )
+
+    def harvest_window(self, handle):
+        self._seam("wharvest")
+        return super().harvest_window(handle)
+
 
 class FaultySliceTransport:
     """Route a ``SlicePagedKVCache``'s broadcasts through a plan.

@@ -54,11 +54,20 @@ def test_paged_decode_bench_runs_and_counts_tokens():
         FLAGSHIP, d_model=64, n_layers=2, d_ff=128, vocab=256,
         max_seq=64, n_heads=4, n_kv_heads=2,
     )
-    tps, sps, host_sps = measure_paged_decode(
-        small, slots=3, prompt_len=8, n_new=10, page_size=4
+    tps, sps, host_sps, overlap_tps, overlap_speedup = (
+        measure_paged_decode(
+            small, slots=3, prompt_len=8, n_new=10, page_size=4
+        )
     )
     assert tps > 0 and sps > 0 and host_sps > 0
     assert abs(tps - 3 * sps) < 1e-6
+    # The overlapped (double-buffered) leg: positive throughput and a
+    # finite speedup ratio vs the serial windowed leg. No lower bound
+    # here — on a sub-ms local relay there is no RTT to hide, so the
+    # ratio legitimately sits near 1.0 (the >= 1.3 expectation applies
+    # only when the measured relay RTT is >= 20 ms).
+    assert overlap_tps > 0
+    assert overlap_speedup > 0
 
 
 def test_paged_mixed_and_adversarial_spec_benches_run():

@@ -49,6 +49,25 @@ def test_boot_secret_name_matches_deployment_ref_when_override_empty():
     assert secret_name == f"{CHART_NAME}-runtime-bootconfig"
 
 
+def test_unset_name_override_is_the_shipped_default():
+    """The default ChartValues ships nameOverride unset ("" — the
+    reference's own shipped state at values.yaml:8) and a default render
+    must produce chart-name-prefixed resources, Secret ref included.
+    Guards the aziot-edge-vm.yaml:57 TODO staying closed: if a renderer
+    ever reads nameOverride raw again, the default render breaks here
+    rather than only under an explicit {"nameOverride": ""} override.
+    """
+    values = ChartValues()
+    assert values.nameOverride == ""
+    dep = runtime_deployment(values)
+    assert dep["metadata"]["name"] == f"{CHART_NAME}-runtime"
+    secret_name = boot_config_secret(values)["metadata"]["name"]
+    vols = dep["spec"]["template"]["spec"]["volumes"]
+    ref = next(v for v in vols if v["name"] == "bootconfigdisk")
+    assert ref["secret"]["secretName"] == secret_name
+    assert secret_name == f"{CHART_NAME}-runtime-bootconfig"
+
+
 def test_trim_suffix_strips_at_most_one_dash():
     # sprig `trimSuffix "-"` removes one dash, not all — byte-parity with
     # the Helm chart depends on this.

@@ -597,3 +597,38 @@ def test_train_payload_rejects_ignored_or_impossible_attention(
     ))
     assert not result.ok
     assert fragment in result.error
+
+
+def test_metrics_render_overlap_gauges_and_histograms():
+    """The overlapped-pipeline serving keys render: scalar gauges plus
+    Prometheus histograms with CUMULATIVE le buckets, +Inf, _sum and
+    _count; a malformed histogram snapshot is skipped, never mis-summed."""
+    from kvedge_tpu.runtime.status import render_metrics
+
+    snapshot = {"serving": {
+        "overlap": 1,
+        "overlap_windows_total": 7,
+        "overlap_inflight_depth": 1,
+        "window_dispatch_harvest_ms": {
+            "edges": [1.0, 5.0], "counts": [2, 3, 1],
+            "sum": 23.5, "count": 6,
+        },
+        "window_inflight_depth": {
+            "edges": [0.0, 1.0], "counts": [4, 3, 0],
+            "sum": 3.0, "count": 7,
+        },
+        "window_host_ms": {"edges": [1.0], "counts": [1]},  # malformed
+    }}
+    body = render_metrics(snapshot)
+    assert "kvedge_serve_overlap 1" in body
+    assert "kvedge_serve_overlap_windows_total 7" in body
+    assert "kvedge_serve_overlap_inflight_depth 1" in body
+    name = "kvedge_serve_window_dispatch_harvest_ms"
+    assert f"# TYPE {name} histogram" in body
+    assert f'{name}_bucket{{le="1"}} 2' in body
+    assert f'{name}_bucket{{le="5"}} 5' in body  # cumulative, not 3
+    assert f'{name}_bucket{{le="+Inf"}} 6' in body
+    assert f"{name}_sum 23.5" in body
+    assert f"{name}_count 6" in body
+    assert 'kvedge_serve_window_inflight_depth_bucket{le="0"} 4' in body
+    assert "kvedge_serve_window_host_ms" not in body

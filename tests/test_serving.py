@@ -293,9 +293,10 @@ def test_admission_control_rejects_impossible_and_times_out(params):
         # lock race until the occupier finishes (observed flake).
         import time as time_mod
 
-        server.submit([9, 9, 9], n_new=30)  # compile prefill + windows
+        server.submit([9, 9, 9], n_new=44)  # compile prefill + windows
 
         real_window = server._cache.step_window
+        real_dispatch = server._cache.dispatch_window
 
         def slow_window(*args, **kwargs):
             # Sleep > the competitor's full timeout: even a single
@@ -304,19 +305,34 @@ def test_admission_control_rejects_impossible_and_times_out(params):
             time_mod.sleep(0.25)
             return real_window(*args, **kwargs)
 
+        def slow_dispatch(*args, **kwargs):
+            # The overlapped loop (serving_overlap, the default) goes
+            # through dispatch_window instead of step_window — slow
+            # both so the test pins admission timing on either path.
+            time_mod.sleep(0.25)
+            return real_dispatch(*args, **kwargs)
+
         server._cache.step_window = slow_window
+        server._cache.dispatch_window = slow_dispatch
         t = threading.Thread(
-            target=lambda: server.submit([1, 2, 3], n_new=30)
+            target=lambda: server.submit([1, 2, 3], n_new=44)
         )
         t.start()
         deadline = time_mod.monotonic() + 30
-        while (server.stats()["in_flight"] < 1
+        # Dirty read on purpose: stats() takes the server lock, which
+        # the slowed decode loop holds ~continuously, so the poll
+        # itself could lose the lock race for most of the occupier's
+        # lifetime and start the competitor too late to ever observe
+        # an occupied boundary (seen with the overlapped loop). A
+        # lock-free peek at _active starts the competitor immediately.
+        while (not server._active
                and time_mod.monotonic() < deadline):
             time_mod.sleep(0.005)  # occupier must hold the slot first
         with pytest.raises(ServerBusy):
             server.submit([4, 5], n_new=2, timeout=0.2)
         t.join(timeout=300)
         server._cache.step_window = real_window
+        server._cache.dispatch_window = real_dispatch
     finally:
         server.close()
 
@@ -964,12 +980,23 @@ def test_multipage_window_matches_generate(params):
                                    page_size=4, window=16)
     windows: list[int] = []
     real_window = server._cache.step_window
+    real_dispatch = server._cache.dispatch_window
 
     def spy_window(params_, tokens, n_steps, active=None):
         windows.append(n_steps)
         return real_window(params_, tokens, n_steps, active=active)
 
+    def spy_dispatch(params_, tokens, n_steps, active=None,
+                     steps_left=None):
+        # The overlapped loop (default serving_overlap) dispatches
+        # through here; the window plan is identical to the serial
+        # path's, so the assertions below hold for both loop bodies.
+        windows.append(n_steps)
+        return real_dispatch(params_, tokens, n_steps, active=active,
+                             steps_left=steps_left)
+
     server._cache.step_window = spy_window
+    server._cache.dispatch_window = spy_dispatch
     try:
         prompt = [11, 3, 8]
         got = server.submit(prompt, n_new=40)
@@ -980,6 +1007,7 @@ def test_multipage_window_matches_generate(params):
         assert len(windows) <= 6
     finally:
         server._cache.step_window = real_window
+        server._cache.dispatch_window = real_dispatch
         server.close()
 
 

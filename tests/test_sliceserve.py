@@ -308,3 +308,59 @@ def test_slice_stop_after_dead_stream_is_bounded(params, mesh):
     cache.stop()
     assert _time.monotonic() - start < 5.0
     release.set()
+
+
+def test_slice_pipelined_windows_replay_matches_plain(params, mesh):
+    """OP_WINDOWP protocol replay (degenerate single-process broadcast):
+    two pipelined windows — the second dispatched on the device carry
+    BEFORE the first is harvested, header + payload riding the ordered
+    op stream, the harvest deliberately NOT a broadcast — produce the
+    plain cache's pipelined tokens exactly."""
+    prompt = [3, 1, 4, 1, 5, 9, 2]
+    seqs = []
+    for cache in (
+        PagedKVCache(CFG, slots=2, pages=16, page_size=4),
+        SlicePagedKVCache(CFG, slots=2, pages=16, page_size=4,
+                          mesh=mesh),
+    ):
+        cache.admit(0, len(prompt))
+        logits = cache.prefill(params, 0,
+                               jnp.asarray(prompt, jnp.int32))
+        pend = np.zeros((2,), np.int32)
+        pend[0] = int(np.argmax(np.asarray(logits)))
+        active = np.array([True, False])
+        h1 = cache.dispatch_window(params, jnp.asarray(pend), 4,
+                                   active=active)
+        h2 = cache.dispatch_window(params, None, 4, active=active)
+        toks = np.concatenate([np.asarray(cache.harvest_window(h1)),
+                               np.asarray(cache.harvest_window(h2))])
+        cache.drop_carry()
+        seqs.append(toks[:, 0].tolist())
+        assert cache._carry is None
+    assert seqs[0] == seqs[1]
+
+
+def test_slice_overlap_server_greedy_and_sampled_match_plain(params,
+                                                             mesh):
+    """The pipelined serving loop over the slice cache (OP_WINDOWP /
+    OP_WSAMPLEP in steady state) serves the same tokens as the plain
+    pipelined server — greedy against contiguous generate, sampled
+    bit-identical across backends under one seed."""
+    key = jax.random.fold_in(jax.random.PRNGKey(3), 0)
+    prompt_g, prompt_s = [5, 9, 2, 7, 1], [1, 2, 3, 4]
+    plain = PagedGenerationServer(params, CFG, slots=3, pages=24,
+                                  overlap="on")
+    sliced = _slice_server(params, mesh, overlap="on")
+    try:
+        results = []
+        for server in (plain, sliced):
+            sampling = (key, jnp.float32(0.8), jnp.float32(0.9))
+            greedy = server.submit(prompt_g, n_new=12)
+            sampled = server.submit(prompt_s, n_new=18,
+                                    sampling=sampling)
+            results.append((greedy, sampled))
+        assert results[0] == results[1]
+        assert results[0][0] == reference(params, prompt_g, 12)
+    finally:
+        plain.close()
+        sliced.close()

@@ -30,6 +30,9 @@ Usage::
     python tools/run_tests.py --recovery # only the recovery-supervisor
                                          # tests (-m recovery); fast,
                                          # also tier-1
+    python tools/run_tests.py --overlap  # only the overlapped-window
+                                         # exactness tests (-m overlap);
+                                         # fast, also tier-1
     python tools/run_tests.py --list     # show the shard plan only
 
 Prints a per-shard progress line and ONE aggregate summary; exits 0
@@ -158,6 +161,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--recovery", action="store_true",
                     help="run only the recovery-supervisor tests "
                          "(forwards -m recovery)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="run only the overlapped-window pipeline "
+                         "exactness tests (forwards -m overlap)")
     ap.add_argument("pytest_args", nargs="*",
                     help="extra args forwarded to pytest (e.g. -k expr)")
     args, unknown = ap.parse_known_args(argv)
@@ -166,6 +172,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "fault"]
     if args.recovery:
         args.pytest_args += ["-m", "recovery"]
+    if args.overlap:
+        args.pytest_args += ["-m", "overlap"]
 
     counts = collect_counts(args.pytest_args)
     if not counts:
