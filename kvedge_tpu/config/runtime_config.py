@@ -347,6 +347,32 @@ class RuntimeConfig:
     # supervisor entirely — every poisoning failure is immediately
     # terminal, the pre-rung-15 behavior.
     serving_recovery_attempts: int = 2
+    # SLO-aware admission scheduling for the paged backend (SERVING.md
+    # rung 17, models/scheduler.py). Policy across priority classes
+    # (requests carry [payload-level] "priority": interactive|batch):
+    # "strict" admits the best class first (FIFO within a class),
+    # "weighted" shares by serving_sched_weights, "fifo" ignores
+    # classes — the baseline the bench overload leg compares against.
+    serving_sched_policy: str = "strict"
+    # Weighted-policy shares, "class=weight,..." (ignored unless
+    # serving_sched_policy = "weighted"). Higher weight = more
+    # admissions per round; every class with weight > 0 keeps making
+    # progress, so batch traffic is never starved outright.
+    serving_sched_weights: str = "interactive=4,batch=1"
+    # Overload shedding watermarks: reject a submit IMMEDIATELY (with
+    # the measured per-class queue wait as the retry_after hint)
+    # instead of letting it burn its timeout — when more than this many
+    # requests are already parked (0 = no depth watermark) ...
+    serving_sched_max_queue_depth: int = 0
+    # ... or when the measured queue wait for the request's class
+    # exceeds this many seconds (0 = no wait watermark).
+    serving_sched_max_queue_wait_s: float = 0.0
+    # Host-RAM budget (MB) for preemptive KV swap: when a higher-class
+    # request cannot admit, the scheduler may swap a lower-class
+    # victim's live pages to host RAM at a window boundary and resume
+    # it later, bit-identically. 0 disables preemption (priority
+    # ordering still applies at admission).
+    serving_sched_swap_budget_mb: int = 0
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -499,6 +525,26 @@ class RuntimeConfig:
                     payload_doc.get("serving_recovery_attempts",
                                     cls.serving_recovery_attempts)
                 ),
+                serving_sched_policy=str(
+                    payload_doc.get("serving_sched_policy",
+                                    cls.serving_sched_policy)
+                ),
+                serving_sched_weights=str(
+                    payload_doc.get("serving_sched_weights",
+                                    cls.serving_sched_weights)
+                ),
+                serving_sched_max_queue_depth=int(
+                    payload_doc.get("serving_sched_max_queue_depth",
+                                    cls.serving_sched_max_queue_depth)
+                ),
+                serving_sched_max_queue_wait_s=float(
+                    payload_doc.get("serving_sched_max_queue_wait_s",
+                                    cls.serving_sched_max_queue_wait_s)
+                ),
+                serving_sched_swap_budget_mb=int(
+                    payload_doc.get("serving_sched_swap_budget_mb",
+                                    cls.serving_sched_swap_budget_mb)
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -519,6 +565,32 @@ class RuntimeConfig:
             raise RuntimeConfigError(f"wrongly-typed config value: {e}") from e
         cfg.validate()
         return cfg
+
+    def sched_weights_dict(self) -> dict[str, float]:
+        """Parse ``serving_sched_weights`` ("class=weight,...") to a dict.
+
+        Raises ``ValueError`` on malformed entries or non-positive
+        weights; validate() surfaces that as a RuntimeConfigError and
+        workload.py reuses the parsed dict when building the server.
+        """
+        out: dict[str, float] = {}
+        for part in self.serving_sched_weights.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, sep, val = part.partition("=")
+            name = name.strip()
+            if not sep or not name:
+                raise ValueError(
+                    f"expected 'class=weight', got {part!r}"
+                )
+            weight = float(val.strip())
+            if weight <= 0:
+                raise ValueError(
+                    f"weight for {name!r} must be > 0, got {weight}"
+                )
+            out[name] = weight
+        return out
 
     def validate(self) -> None:
         if not self.name:
@@ -609,6 +681,33 @@ class RuntimeConfig:
                 "[payload] serving_recovery_attempts must be >= 0 "
                 "(0 = no in-process recovery; degrade is terminal)"
             )
+        if self.serving_sched_policy not in ("fifo", "strict",
+                                             "weighted"):
+            raise RuntimeConfigError(
+                "[payload] serving_sched_policy must be 'fifo', "
+                "'strict' or 'weighted'"
+            )
+        try:
+            self.sched_weights_dict()
+        except ValueError as e:
+            raise RuntimeConfigError(
+                f"[payload] serving_sched_weights: {e}"
+            ) from None
+        if self.serving_sched_max_queue_depth < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_sched_max_queue_depth must be >= 0 "
+                "(0 = no depth watermark)"
+            )
+        if self.serving_sched_max_queue_wait_s < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_sched_max_queue_wait_s must be >= 0 "
+                "(0 = no wait watermark)"
+            )
+        if self.serving_sched_swap_budget_mb < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_sched_swap_budget_mb must be >= 0 "
+                "(0 = preemptive swap off)"
+            )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
                 "[payload] kind = 'train' requires corpus = '<path>' "
@@ -693,6 +792,14 @@ class RuntimeConfig:
             f"{s(self.serving_speculative) if isinstance(self.serving_speculative, str) else self.serving_speculative}\n"
             f"serving_retry_after_s = {self.serving_retry_after_s}\n"
             f"serving_recovery_attempts = {self.serving_recovery_attempts}\n"
+            f"serving_sched_policy = {s(self.serving_sched_policy)}\n"
+            f"serving_sched_weights = {s(self.serving_sched_weights)}\n"
+            "serving_sched_max_queue_depth = "
+            f"{self.serving_sched_max_queue_depth}\n"
+            "serving_sched_max_queue_wait_s = "
+            f"{self.serving_sched_max_queue_wait_s}\n"
+            "serving_sched_swap_budget_mb = "
+            f"{self.serving_sched_swap_budget_mb}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

@@ -440,6 +440,50 @@ class PagedKVCache:
             ),
         )
 
+    # ---- preemptive swap (scheduler layer, SERVING.md rung 17) ----------
+
+    def _device_swapout(self, ids: list[int]):
+        """Device seam: gather pages ``ids`` AS STORED (fresh arrays,
+        immune to the decode steps' buffer donation). The slice cache
+        overrides this to broadcast an OP_SWAPOUT so followers replay
+        the gather in the totally-ordered op stream."""
+        return _gather_pages_impl(self.state, jnp.asarray(ids, jnp.int32))
+
+    def swapout_pages(self, ids: list[int]) -> tuple:
+        """Host copies of pages ``ids`` EXACTLY as the pool stores them
+        (2-tuple ``(k, v)`` for a bf16 pool, 4-tuple with the fp32
+        scale slabs for int8) — the preemption snapshot. Unlike
+        :meth:`read_pages`/:meth:`write_pages` (the persistence pair,
+        which dequantize/re-quantize and accept one int8 step of
+        error), a swap round trip must be BIT-identical: a preempted
+        request's resumed token stream is pinned equal to the
+        never-preempted one, so the pool bytes go to host verbatim and
+        come back verbatim via :meth:`swapin_pages`."""
+        import numpy as np
+
+        return tuple(np.asarray(x) for x in self._device_swapout(ids))
+
+    def _device_swapin(self, ids: list[int], arrays: tuple) -> None:
+        """Device seam: scatter as-stored ``arrays`` into pages ``ids``
+        (one batched update per pool). Slice cache broadcasts."""
+        self.state = _scatter_pages_impl(
+            self.state, jnp.asarray(ids, jnp.int32),
+            tuple(jnp.asarray(a) for a in arrays),
+        )
+
+    def swapin_pages(self, ids: list[int], arrays: tuple) -> None:
+        """Write a :meth:`swapout_pages` snapshot back into pages
+        ``ids`` (freshly allocated by the resume path's re-admission —
+        the caller owns allocation/refcounts). Verbatim: no dtype
+        conversion happens in either direction."""
+        if len(arrays) != (4 if self.kv_quantized else 2):
+            raise PagedCacheError(
+                f"swap snapshot carries {len(arrays)} arrays; this "
+                f"pool needs {4 if self.kv_quantized else 2} "
+                "(kv_dtype mismatch between swap-out and swap-in?)"
+            )
+        self._device_swapin(ids, arrays)
+
     def allocate_pinned_page(self) -> int:
         """Take one page off the free list with refcount 1, owned by the
         caller (the persistence loader's registry pins — there is no
@@ -800,6 +844,37 @@ class PagedKVCache:
 
 
 # ---- jitted kernels ------------------------------------------------------
+
+
+def _gather_pages_impl(state: PagedState, idx):
+    """Pages ``idx`` of every pool slab, as stored: a 2-or-4 tuple of
+    fresh ``[L, n, page, K, Dh]`` / ``[L, n, page, K]`` arrays. Shared
+    by the single-host swap-out seam (plain dispatch) and the slice
+    cache's jitted replicated gather (runtime/sliceserve.py jits it
+    with ``out_shardings`` replicated, so the leader can read the swap
+    snapshot host-side while followers hold the same bytes)."""
+    out = [state.pool_k[:, idx], state.pool_v[:, idx]]
+    if state.scale_k is not None:
+        out += [state.scale_k[:, idx], state.scale_v[:, idx]]
+    return tuple(out)
+
+
+def _scatter_pages_impl(state: PagedState, idx, arrays) -> PagedState:
+    """Scatter as-stored ``arrays`` (a :func:`_gather_pages_impl`
+    tuple) into pages ``idx`` — ONE batched update per slab, no dtype
+    conversion (the swap-in path's bit-exactness contract). Shared by
+    the single-host seam and the slice cache's jitted donating
+    scatter."""
+    fields = dict(
+        pool_k=state.pool_k.at[:, idx].set(arrays[0]),
+        pool_v=state.pool_v.at[:, idx].set(arrays[1]),
+    )
+    if state.scale_k is not None:
+        fields.update(
+            scale_k=state.scale_k.at[:, idx].set(arrays[2]),
+            scale_v=state.scale_v.at[:, idx].set(arrays[3]),
+        )
+    return dataclasses.replace(state, **fields)
 
 
 def _gathered(state: PagedState, layer_slabs, dtype):

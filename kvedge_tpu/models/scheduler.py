@@ -1,0 +1,461 @@
+"""SLO-aware admission scheduling for the paged serving stack.
+
+SERVING.md rung 17. The serving layer (models/serving.py) used to admit
+through a bare ``Condition.notify_all`` wait: admission order was
+whatever the lock handed out, a long batch job and a latency-critical
+interactive request were indistinguishable, and the only overload
+behavior was each caller burning its full timeout into ``ServerBusy``.
+This module is the policy layer that turns the paged pool's existing
+mechanisms (worst-case reservation, refcounted pages, boundary-only
+mutation) into controlled behavior under contention. Three pillars:
+
+* **Priority admission.** Every request carries a priority class
+  (``interactive``/``batch`` by default — the class list is a
+  constructor argument, so it is extensible) and an optional deadline.
+  Waiters park on a per-class ticketed queue: each ticket gets its OWN
+  condition variable on the server lock, and only the policy head is
+  ever woken, so admission is FIFO within a class by construction —
+  no thundering herd, no lock-convoy ordering races. Across classes
+  the ``policy`` knob picks strict priority (head = best class with a
+  waiter), weighted sharing (deficit-style weighted round-robin, so a
+  flood of interactive work cannot starve batch forever), or plain
+  global FIFO (the baseline the bench's overload leg compares
+  against).
+
+* **Preemptive KV swap.** When the head of the queue cannot admit and
+  a strictly lower-class request holds a slot, the decode loop (at a
+  non-overlapped window boundary — the only place cache state is
+  quiescent) swaps the victim out: its live pages are snapshotted to
+  host RAM AS STORED (``PagedKVCache.swapout_pages`` — verbatim pool
+  bytes, including the int8 scale slabs, so restore is bit-identical),
+  its slot and reservation are released, and a resume entry carrying
+  its ORIGINAL ticket number re-enters the class queue. Resume re-runs
+  admission (worst-case reservation first — the same invariant that
+  makes normal admission safe makes swap-in safe), writes the bytes
+  back, and the request continues from its saved length; the
+  positional sampling-key schedule makes the resumed token stream
+  bit-identical to a never-preempted run. Host memory for snapshots is
+  bounded by ``swap_budget_mb``; 0 disables preemption entirely.
+
+* **Overload shedding.** Queue-depth and measured-queue-wait
+  watermarks reject at submit time with the measured ``retry_after``
+  hint (an EWMA of recent per-class admission waits), instead of
+  letting every caller burn its full timeout. A request whose own
+  deadline is provably unmeetable (estimated wait exceeds
+  ``deadline_ms``) is shed the same way.
+
+The scheduler is pure policy + bookkeeping: it raises no serving
+exceptions and touches no cache state. Every method that ends in
+``_locked`` MUST be called with the server's work lock held — the
+scheduler deliberately shares that one lock (SERVING.md invariant 5)
+instead of adding its own, so queue state, slot state, and page
+accounting mutate atomically together.
+
+The reference has no serving at all (SURVEY.md §0); the scheduling
+design follows vLLM's preempt-via-swap (Kwon et al., SOSP '23) and
+Sarathi-Serve's SLO-aware admission (Agrawal et al., OSDI '24) adapted
+to this repo's boundary-only, exactness-pinned serving loop.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+
+# Priority classes in RANK ORDER: index 0 is the most latency-critical.
+# The serving layer passes this default; deployments with more tiers
+# hand AdmissionScheduler a longer tuple.
+DEFAULT_CLASSES = ("interactive", "batch")
+
+# Queue-wait histogram buckets (milliseconds). Sub-ms admissions land
+# in the first bucket; the top edge is the default submit timeout.
+_WAIT_EDGES_MS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0,
+                  5000.0, 10000.0, 30000.0, 60000.0, 120000.0)
+
+# EWMA smoothing for the measured per-class queue wait (the shed
+# watermark and the retry_after hint): ~5 admissions of memory.
+_EWMA_ALPHA = 0.2
+
+
+class _Hist:
+    """Fixed-bucket histogram in Prometheus shape: ``edges`` are ``le``
+    upper bounds, counts are stored PER bucket (last slot = +Inf) and
+    cumulated at render time (runtime/status.py), so one observation
+    touches one counter. Mutated only under the server lock; snapshots
+    copy plain ints/floats."""
+
+    __slots__ = ("edges", "counts", "total", "n")
+
+    def __init__(self, edges: tuple):
+        self.edges = tuple(float(e) for e in edges)
+        self.counts = [0] * (len(self.edges) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        # bisect_left: v == edge lands IN that edge's bucket (le means
+        # "less than or equal", the Prometheus boundary convention).
+        self.counts[bisect.bisect_left(self.edges, v)] += 1
+        self.total += v
+        self.n += 1
+
+    def snapshot(self) -> dict:
+        return {"edges": list(self.edges), "counts": list(self.counts),
+                "sum": self.total, "count": self.n}
+
+
+class _Entry:
+    """One queued admission unit.
+
+    Either a parked ticket (a live submitter thread waiting on
+    ``cond``) or a resume entry (a preempted request's swapped-out
+    state, serviced by the decode loop at a boundary — no thread, no
+    condition). ``no`` is the global arrival ticket: FIFO within a
+    class orders by it, and a resume entry KEEPS the number it was
+    first admitted under, so a preempted request re-enters ahead of
+    everything that arrived after it.
+    """
+
+    __slots__ = ("no", "pclass", "req", "pages_needed", "cond",
+                 "enqueued_at", "resume", "saved_len", "arrays",
+                 "nbytes")
+
+    def __init__(self, no: int, pclass: str, req, pages_needed: int,
+                 cond, enqueued_at: float, *, resume: bool = False,
+                 saved_len: int = 0, arrays: tuple = (),
+                 nbytes: int = 0):
+        self.no = no
+        self.pclass = pclass
+        self.req = req
+        self.pages_needed = pages_needed
+        self.cond = cond
+        self.enqueued_at = enqueued_at
+        self.resume = resume
+        self.saved_len = saved_len
+        self.arrays = arrays
+        self.nbytes = nbytes
+
+
+class AdmissionScheduler:
+    """Per-class ticketed admission queue + preemption bookkeeping.
+
+    Owns WHO runs: queue order, the policy head, shed watermarks, the
+    swapped-out set, and every scheduling counter/histogram exported
+    through ``/metrics``. It does not own HOW anything runs — slot
+    assignment, page reservation, and the actual swap device calls stay
+    in the serving layer, which calls in under its own lock.
+    """
+
+    def __init__(self, lock, *, policy: str = "strict",
+                 weights: dict | None = None,
+                 classes: tuple = DEFAULT_CLASSES,
+                 max_queue_depth: int = 0,
+                 max_queue_wait_s: float = 0.0,
+                 swap_budget_mb: int = 0):
+        if policy not in ("fifo", "strict", "weighted"):
+            raise ValueError(
+                f"scheduler policy must be 'fifo', 'strict' or "
+                f"'weighted', got {policy!r}"
+            )
+        if not classes:
+            raise ValueError("need at least one priority class")
+        self._lock = lock
+        self.policy = policy
+        self.classes = tuple(classes)
+        self._rank = {c: i for i, c in enumerate(self.classes)}
+        self._weights = {c: 1.0 for c in self.classes}
+        for c, w in (weights or {}).items():
+            if c not in self._rank:
+                raise ValueError(f"weight for unknown priority class "
+                                 f"{c!r} (known: {self.classes})")
+            if w <= 0:
+                raise ValueError(f"priority weight for {c!r} must be "
+                                 f"> 0, got {w}")
+            self._weights[c] = float(w)
+        self.max_queue_depth = int(max_queue_depth)
+        self.max_queue_wait_s = float(max_queue_wait_s)
+        self.swap_budget_bytes = int(swap_budget_mb) * (1 << 20)
+        # Per-class queues of _Entry, kept sorted by ticket number
+        # (resume entries re-enter with OLD numbers, so insertion is a
+        # sorted insert, not an append).
+        self._queues: dict[str, list] = {c: [] for c in self.classes}
+        self._next_no = 0
+        # Admission sequence: victim selection preempts the LATEST
+        # admitted request of the lowest class (least progress lost).
+        self._next_admit_seq = 0
+        # Weighted policy state: admissions served per class; the head
+        # is the nonempty class minimizing (served+1)/weight, which is
+        # deterministic and stable between admissions.
+        self._served = {c: 0 for c in self.classes}
+        # Measured queue wait per class (seconds, EWMA) — the shed
+        # watermark input and the retry_after hint.
+        self._wait_ewma: dict[str, float | None] = {
+            c: None for c in self.classes
+        }
+        self._hist_wait = {c: _Hist(_WAIT_EDGES_MS)
+                           for c in self.classes}
+        # Host bytes currently held by swap snapshots.
+        self.swap_bytes = 0
+        # Counters (cumulative; survive revive()).
+        self.preemptions = 0
+        self.resumes = 0
+        self.shed = 0
+
+    # ---- ranks & small queries ------------------------------------------
+
+    def rank(self, pclass: str) -> int:
+        """Smaller = more latency-critical. Raises on unknown class."""
+        try:
+            return self._rank[pclass]
+        except KeyError:
+            raise ValueError(
+                f"unknown priority class {pclass!r} "
+                f"(known: {self.classes})"
+            ) from None
+
+    def next_admit_seq_locked(self) -> int:
+        seq = self._next_admit_seq
+        self._next_admit_seq += 1
+        return seq
+
+    def depth_locked(self, pclass: str | None = None) -> int:
+        """Parked tickets (resume entries excluded — those hold no
+        caller thread and are invisible to the shed watermark)."""
+        qs = ([self._queues[pclass]] if pclass is not None
+              else self._queues.values())
+        return sum(1 for q in qs for e in q if not e.resume)
+
+    def depths_locked(self) -> dict:
+        return {c: self.depth_locked(c) for c in self.classes}
+
+    def depth_text_locked(self) -> str:
+        """Per-class queue depth for refusal messages: satellite 2 —
+        a shed or busy caller learns WHAT it is queued behind."""
+        return ", ".join(f"{c}={self.depth_locked(c)}"
+                         for c in self.classes)
+
+    def swapped_locked(self) -> list:
+        return [e for q in self._queues.values() for e in q if e.resume]
+
+    def resume_pending_locked(self) -> bool:
+        return any(e.resume for q in self._queues.values() for e in q)
+
+    @property
+    def preemption_enabled(self) -> bool:
+        """Preemption needs both a class ordering to act on (FIFO has
+        none) and host memory to park victims in."""
+        return self.policy != "fifo" and self.swap_budget_bytes > 0
+
+    # ---- the policy head -------------------------------------------------
+
+    def head_locked(self):
+        """The ONE entry eligible to admit next, or None.
+
+        * ``fifo``: global ticket order — the scheduler degenerates to
+          a fair FIFO (still fixes the notify_all ordering race).
+        * ``strict``: best-ranked class with a waiter, FIFO within.
+        * ``weighted``: deficit-style weighted round-robin — the
+          nonempty class minimizing (served+1)/weight, rank breaking
+          ties — so every class with weight > 0 makes progress.
+
+        Head-of-line is intentional: a later, smaller request never
+        bypasses the head (bypass would starve large requests — the
+        fairness bug this module exists to fix). Preemption, not
+        bypass, is how a blocked high-class head gets capacity.
+        """
+        nonempty = [c for c in self.classes if self._queues[c]]
+        if not nonempty:
+            return None
+        if self.policy == "fifo":
+            return min((self._queues[c][0] for c in nonempty),
+                       key=lambda e: e.no)
+        if self.policy == "strict":
+            return self._queues[nonempty[0]][0]
+        best = min(nonempty,
+                   key=lambda c: ((self._served[c] + 1)
+                                  / self._weights[c], self._rank[c]))
+        return self._queues[best][0]
+
+    # ---- overload shedding -----------------------------------------------
+
+    def shed_check_locked(self, pclass: str,
+                          deadline_ms: int | None) -> dict | None:
+        """Reject-early decision BEFORE enqueue. Returns None (admit to
+        the queue) or ``{"reason", "retry_after_s"}`` — the serving
+        layer turns the latter into a typed refusal carrying the
+        measured hint (satellite 2), so an overloaded server costs a
+        client one RTT, not its full timeout."""
+        est = self._wait_ewma[pclass]
+        if self.max_queue_depth and self.depth_locked() >= self.max_queue_depth:
+            self.shed += 1
+            return {"reason": f"admission queue is full "
+                              f"(depth {self.depth_locked()} >= "
+                              f"watermark {self.max_queue_depth})",
+                    "retry_after_s": est}
+        if self.max_queue_wait_s and est is not None \
+                and est > self.max_queue_wait_s:
+            self.shed += 1
+            return {"reason": f"measured {pclass} queue wait "
+                              f"{est:.2f}s exceeds watermark "
+                              f"{self.max_queue_wait_s:.2f}s",
+                    "retry_after_s": est}
+        if deadline_ms is not None and est is not None \
+                and est > deadline_ms / 1000.0:
+            self.shed += 1
+            return {"reason": f"deadline {deadline_ms}ms is unmeetable "
+                              f"(measured {pclass} queue wait "
+                              f"{est:.2f}s)",
+                    "retry_after_s": est}
+        return None
+
+    # ---- ticket lifecycle ------------------------------------------------
+
+    def enqueue_locked(self, req, pclass: str,
+                       pages_needed: int) -> _Entry:
+        """Park a submitter: a fresh ticket at the class tail. The
+        caller waits on ``entry.cond`` until it is the head AND
+        capacity fits (serving.py's admission loop)."""
+        self.rank(pclass)  # validates
+        e = _Entry(self._next_no, pclass, req, pages_needed,
+                   threading.Condition(self._lock), time.monotonic())
+        self._next_no += 1
+        self._queues[pclass].append(e)  # fresh no == max -> tail
+        return e
+
+    def admit_locked(self, entry: _Entry) -> None:
+        """The head ticket won capacity: dequeue, record its measured
+        queue wait (histogram + EWMA — the shed/hint input), charge the
+        weighted policy, and wake whoever is head now."""
+        self._remove(entry)
+        self._served[entry.pclass] += 1
+        wait = time.monotonic() - entry.enqueued_at
+        self._hist_wait[entry.pclass].observe(wait * 1000.0)
+        prev = self._wait_ewma[entry.pclass]
+        self._wait_ewma[entry.pclass] = (
+            wait if prev is None
+            else (1 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * wait
+        )
+        self.wake_head_locked()
+
+    def remove_locked(self, entry: _Entry) -> None:
+        """Abandon a ticket (timeout, cancel, refusal). Idempotent."""
+        self._remove(entry)
+        self.wake_head_locked()
+
+    def _remove(self, entry: _Entry) -> None:
+        q = self._queues[entry.pclass]
+        for i, e in enumerate(q):
+            if e is entry:
+                del q[i]
+                return
+
+    # ---- wakeups ---------------------------------------------------------
+
+    def wake_head_locked(self) -> None:
+        """Targeted wakeup: only the policy head's waiter stirs — the
+        ticketed replacement for notify_all's thundering herd. Resume
+        entries have no thread; the decode loop is woken by the serving
+        layer's own ``notify_all`` on the work condition."""
+        h = self.head_locked()
+        if h is not None and not h.resume:
+            h.cond.notify_all()
+
+    def wake_all_locked(self) -> None:
+        """Every parked waiter re-evaluates (close/drain/poison/cancel:
+        the predicate changed for reasons other than queue order)."""
+        for q in self._queues.values():
+            for e in q:
+                if not e.resume:
+                    e.cond.notify_all()
+
+    # ---- preemptive swap bookkeeping ------------------------------------
+
+    def swap_fits_locked(self, nbytes: int) -> bool:
+        return (self.swap_budget_bytes > 0
+                and self.swap_bytes + nbytes <= self.swap_budget_bytes)
+
+    def record_swapout_locked(self, req, pclass: str, ticket_no: int,
+                              pages_needed: int, saved_len: int,
+                              arrays: tuple) -> _Entry:
+        """A victim left the device: park its as-stored page bytes and
+        re-queue it under its ORIGINAL ticket number, so it resumes
+        ahead of later arrivals of its class."""
+        nbytes = sum(a.nbytes for a in arrays)
+        e = _Entry(ticket_no, pclass, req, pages_needed, None,
+                   time.monotonic(), resume=True, saved_len=saved_len,
+                   arrays=arrays, nbytes=nbytes)
+        bisect.insort(self._queues[pclass], e, key=lambda x: x.no)
+        self.swap_bytes += nbytes
+        self.preemptions += 1
+        return e
+
+    def pop_resume_locked(self, entry: _Entry) -> None:
+        """The decode loop re-admitted a swapped request: drop the host
+        snapshot accounting and charge the policy like any admission."""
+        self._remove(entry)
+        self.swap_bytes -= entry.nbytes
+        entry.arrays = ()
+        self._served[entry.pclass] += 1
+        self.resumes += 1
+        wait = time.monotonic() - entry.enqueued_at
+        self._hist_wait[entry.pclass].observe(wait * 1000.0)
+        self.wake_head_locked()
+
+    def drop_swapped_locked(self, req) -> _Entry | None:
+        """Cancel-while-swapped-out (satellite 3): free the host
+        snapshot and forget the entry. Returns it (the serving layer
+        fails the waiter) or None if ``req`` is not swapped out."""
+        for q in self._queues.values():
+            for i, e in enumerate(q):
+                if e.resume and e.req is req:
+                    del q[i]
+                    self.swap_bytes -= e.nbytes
+                    e.arrays = ()
+                    self.wake_head_locked()
+                    return e
+        return None
+
+    def take_swapped_locked(self) -> list:
+        """Remove and return EVERY resume entry (degraded mode / hard
+        close: swapped-out requests fail like active ones — rung 14's
+        contract extends to the swap set). Snapshots are freed."""
+        out = []
+        for c, q in self._queues.items():
+            keep = []
+            for e in q:
+                if e.resume:
+                    self.swap_bytes -= e.nbytes
+                    e.arrays = ()
+                    out.append(e)
+                else:
+                    keep.append(e)
+            self._queues[c] = keep
+        return out
+
+    def reset_locked(self) -> None:
+        """Revive/reform: queues and the swap set restart empty (any
+        straggler tickets were woken into the refusal path; snapshots
+        were failed by take_swapped_locked). Cumulative counters and
+        histograms survive — they are observability, not state."""
+        for c in self._queues:
+            self._queues[c] = []
+        self.swap_bytes = 0
+
+    # ---- observability ---------------------------------------------------
+
+    def stats_locked(self) -> dict:
+        out = {
+            "sched_policy": self.policy,
+            "sched_swapped_out": len(self.swapped_locked()),
+            "sched_swap_bytes_host": self.swap_bytes,
+            "sched_preemptions_total": self.preemptions,
+            "sched_resumes_total": self.resumes,
+            "sched_shed_total": self.shed,
+        }
+        for c in self.classes:
+            out[f"sched_queue_depth_{c}"] = self.depth_locked(c)
+            out[f"sched_queue_wait_ms_{c}"] = (
+                self._hist_wait[c].snapshot()
+            )
+        return out

@@ -24,7 +24,6 @@ capability the repo's own README listed as future work.
 
 from __future__ import annotations
 
-import bisect
 import dataclasses
 import queue
 import threading
@@ -37,36 +36,10 @@ from kvedge_tpu.runtime.failures import (
     ServingFailure,
     classify_failure,
 )
+from kvedge_tpu.models.scheduler import AdmissionScheduler, _Hist
 
 # Stream sentinel objects (token queue carries ints, then one of these).
 _STREAM_DONE = object()
-
-
-class _Hist:
-    """Fixed-bucket histogram in Prometheus shape: ``edges`` are ``le``
-    upper bounds, counts are stored PER bucket (last slot = +Inf) and
-    cumulated at render time (runtime/status.py), so one observation
-    touches one counter. Mutated only under the server lock; snapshots
-    copy plain ints/floats."""
-
-    __slots__ = ("edges", "counts", "total", "n")
-
-    def __init__(self, edges: tuple):
-        self.edges = tuple(float(e) for e in edges)
-        self.counts = [0] * (len(self.edges) + 1)
-        self.total = 0.0
-        self.n = 0
-
-    def observe(self, v: float) -> None:
-        # bisect_left: v == edge lands IN that edge's bucket (le means
-        # "less than or equal", the Prometheus boundary convention).
-        self.counts[bisect.bisect_left(self.edges, v)] += 1
-        self.total += v
-        self.n += 1
-
-    def snapshot(self) -> dict:
-        return {"edges": list(self.edges), "counts": list(self.counts),
-                "sum": self.total, "count": self.n}
 
 
 def _raw_key_data(key) -> np.ndarray:
@@ -95,6 +68,18 @@ def _raw_key_data(key) -> np.ndarray:
 
 class ServerBusy(RuntimeError):
     """No slot/page capacity became available within the timeout."""
+
+
+class ServerOverloaded(ServerBusy):
+    """Shed at admission by the scheduler's overload watermarks —
+    raised BEFORE parking, so the caller pays one RTT instead of its
+    full timeout. ``retry_after_s`` (when measurable) is the measured
+    per-class queue wait; the HTTP layer forwards it as a hint."""
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg)
+        if retry_after_s is not None:
+            self.retry_after_s = retry_after_s
 
 
 class ServerClosed(RuntimeError):
@@ -135,6 +120,14 @@ class _Request:
     # Cancellation request (consumer gone / explicit): honored at the
     # next loop iteration — the step/window in flight completes first.
     cancelled: bool = False
+    # Scheduler (models/scheduler.py): the request's priority class,
+    # its admission ticket number (kept across preemption so a resumed
+    # request re-queues ahead of later arrivals), and the admission
+    # sequence victim selection orders by (preempt the LATEST admitted
+    # request of the lowest class — least progress lost).
+    pclass: str = "interactive"
+    ticket_no: int = -1
+    admit_seq: int = -1
     # Overlap pipeline bookkeeping: tokens this request will receive
     # from windows that are DISPATCHED but not yet harvested.
     # len(generated) + inflight is the request's committed position —
@@ -213,7 +206,11 @@ class PagedGenerationServer:
                  speculative: int = 0, window: int = 64,
                  kv_dtype: str = "", cache=None,
                  retry_after_s: float | None = None,
-                 overlap: str = "auto"):
+                 overlap: str = "auto", sched_policy: str = "strict",
+                 sched_weights: dict | None = None,
+                 sched_max_queue_depth: int = 0,
+                 sched_max_queue_wait_s: float = 0.0,
+                 sched_swap_budget_mb: int = 0):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
@@ -341,6 +338,25 @@ class PagedGenerationServer:
         self._reserved = 0  # worst-case pages of every in-flight request
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
+        # Admission scheduler (models/scheduler.py, SERVING.md rung 17):
+        # per-class ticketed queue + preemption/shed policy. It SHARES
+        # the server lock — queue order, slot state, and page
+        # accounting mutate atomically together (invariant 5). With the
+        # defaults (strict policy, single implicit class, no
+        # watermarks, no swap budget) it degenerates to a fair FIFO:
+        # every pre-scheduler exactness test runs unchanged on top of
+        # it.
+        self._sched = AdmissionScheduler(
+            self._lock, policy=sched_policy, weights=sched_weights,
+            max_queue_depth=sched_max_queue_depth,
+            max_queue_wait_s=sched_max_queue_wait_s,
+            swap_budget_mb=sched_swap_budget_mb,
+        )
+        # Host bytes one swapped-out page costs (k + v + int8 scale
+        # slabs) — victim-sized budget checks BEFORE paying the device
+        # gather. Filled lazily: the pool arrays exist after the cache
+        # does.
+        self._swap_page_bytes: int | None = None
         self._active: dict[int, _Request] = {}
         self._free_slots = list(range(slots))[::-1]
         self._closed = False
@@ -381,18 +397,28 @@ class PagedGenerationServer:
     # ---- public API ------------------------------------------------------
 
     def submit(self, prompt: list[int], n_new: int,
-               timeout: float = 120.0, sampling: tuple | None = None
-               ) -> list[int]:
+               timeout: float = 120.0, sampling: tuple | None = None,
+               priority: str = "interactive",
+               deadline_ms: int | None = None) -> list[int]:
         """Blocking generate: returns ``prompt + n_new`` tokens.
 
         Greedy unless ``sampling = (seed_key, temperature, top_p)`` —
         then token ``t`` samples with ``fold_in(seed_key, t)`` through
         the same nucleus filter as the contiguous backend, so the two
-        produce identical tokens for identical requests. Raises
-        :class:`ServerBusy` when capacity doesn't free up within
-        ``timeout``, ValueError for requests that can never fit.
+        produce identical tokens for identical requests.
+
+        ``priority`` names the request's scheduling class
+        (``interactive``/``batch``); ``deadline_ms`` optionally bounds
+        the ADMISSION wait tighter than ``timeout`` and lets the
+        scheduler shed the request up front when the measured queue
+        wait makes the deadline unmeetable. Raises :class:`ServerBusy`
+        when capacity doesn't free up in time (a subclass,
+        :class:`ServerOverloaded`, when shed early by the overload
+        watermarks), ValueError for requests that can never fit.
         """
-        req = self._start(prompt, n_new, timeout, sampling, stream=False)
+        req = self._start(prompt, n_new, timeout, sampling,
+                          stream=False, priority=priority,
+                          deadline_ms=deadline_ms)
         req.done.wait()
         if req.error is not None:
             raise req.error
@@ -400,19 +426,24 @@ class PagedGenerationServer:
 
     def submit_stream(self, prompt: list[int], n_new: int,
                       timeout: float = 120.0,
-                      sampling: tuple | None = None) -> "StreamHandle":
+                      sampling: tuple | None = None,
+                      priority: str = "interactive",
+                      deadline_ms: int | None = None) -> "StreamHandle":
         """Streaming generate: an iterator yielding each generated token
         as it lands, with a ``cancel()`` method.
 
-        Same admission/sampling semantics as :meth:`submit`. A consumer
-        that merely stops iterating leaves the request decoding out its
-        reserved budget (co-tenants are never perturbed); a consumer
-        that KNOWS the client is gone calls ``cancel()`` and the request
-        releases its slot and pages at the next step/window boundary. A
-        mid-stream failure raises from the iterator after the tokens
-        already produced.
+        Same admission/sampling/priority semantics as :meth:`submit`. A
+        consumer that merely stops iterating leaves the request decoding
+        out its reserved budget (co-tenants are never perturbed); a
+        consumer that KNOWS the client is gone calls ``cancel()`` and
+        the request releases its slot and pages at the next step/window
+        boundary — or immediately if it is still parked in the
+        admission queue or swapped out. A mid-stream failure raises
+        from the iterator after the tokens already produced.
         """
-        req = self._start(prompt, n_new, timeout, sampling, stream=True)
+        req = self._start(prompt, n_new, timeout, sampling,
+                          stream=True, priority=priority,
+                          deadline_ms=deadline_ms)
         return StreamHandle(self, req)
 
     def cancel(self, req: _Request) -> None:
@@ -424,6 +455,22 @@ class PagedGenerationServer:
         """
         with self._work:
             req.cancelled = True
+            # Cancel-while-swapped-out: the request holds no slot and
+            # no reservation — only a host snapshot. Free it here (no
+            # decode-loop boundary will ever see this request again)
+            # and fail the waiter.
+            entry = self._sched.drop_swapped_locked(req)
+            if entry is not None:
+                req.error = RequestCancelled(
+                    "request cancelled while swapped out"
+                )
+                if req.stream is not None:
+                    req.stream.put(req.error)
+                req.done.set()
+            # Cancel-while-parked: the waiter owns its ticket — wake
+            # every parked thread so the cancelled one can dequeue
+            # itself without consuming a slot or reservation.
+            self._sched.wake_all_locked()
             self._work.notify_all()
 
     def _refusal(self) -> Exception:
@@ -442,6 +489,7 @@ class PagedGenerationServer:
                 hint = self._retry_after_s
             e = PoolPoisoned(
                 f"serving pool is poisoned ({self._degraded_reason}); "
+                f"queue depth [{self._sched.depth_text_locked()}]; "
                 f"retry against the recovered or rescheduled pod",
                 **({} if hint is None else {"retry_after_s": hint}),
             )
@@ -452,10 +500,28 @@ class PagedGenerationServer:
             else "server is shut down"
         )
 
+    def _retry_hint(self) -> float | None:
+        """The live retry-after hint (lock held): the recovery
+        supervisor's measured estimate when installed, else the static
+        config default."""
+        if self.retry_after_hint is not None:
+            try:
+                hint = self.retry_after_hint()
+            except Exception:
+                hint = None
+            if hint is not None:
+                return hint
+        return self._retry_after_s
+
     def _start(self, prompt: list[int], n_new: int, timeout: float,
-               sampling: tuple | None, stream: bool) -> _Request:
+               sampling: tuple | None, stream: bool,
+               priority: str = "interactive",
+               deadline_ms: int | None = None) -> _Request:
         if not prompt or n_new < 1:
             raise ValueError("need a non-empty prompt and n_new >= 1")
+        self._sched.rank(priority)  # unknown classes fail fast
+        if deadline_ms is not None and deadline_ms < 1:
+            raise ValueError("deadline_ms must be >= 1")
         total = len(prompt) + n_new
         if total > self._cfg.max_seq:
             raise ValueError(
@@ -483,22 +549,81 @@ class PagedGenerationServer:
             pages_reserved=pages_needed,
             key_data=_raw_key_data(sampling[0]) if sampling else None,
             stream=queue.SimpleQueue() if stream else None,
+            pclass=priority,
         )
         deadline = time.monotonic() + timeout
+        if deadline_ms is not None:
+            deadline = min(deadline,
+                           time.monotonic() + deadline_ms / 1000.0)
         with self._work:
-            while (not self._closed and not self._draining
-                   and (not self._free_slots
-                        or self._reserved + pages_needed
-                        > self._pages_total)):
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise ServerBusy(
-                        "no slot/page capacity within the timeout "
-                        f"({len(self._active)} requests in flight)"
-                    )
-                self._work.wait(timeout=remaining)
             if self._closed or self._draining:
                 raise self._refusal()
+            # Overload shedding: reject BEFORE parking when the queue
+            # watermarks say the wait is hopeless, with the measured
+            # per-class wait as the retry hint (falling back to the
+            # recovery machinery's hint).
+            shed = self._sched.shed_check_locked(priority, deadline_ms)
+            if shed is not None:
+                hint = shed["retry_after_s"]
+                if hint is None:
+                    hint = self._retry_hint()
+                raise ServerOverloaded(
+                    f"request shed: {shed['reason']}; queue depth "
+                    f"[{self._sched.depth_text_locked()}]"
+                    + (f"; retry after ~{hint:.1f}s" if hint is not None
+                       else ""),
+                    retry_after_s=hint,
+                )
+            # Ticketed admission (SERVING.md rung 17): park on a
+            # per-class FIFO ticket and wait on the TICKET's condition.
+            # Only the policy head is ever woken, and only the head
+            # takes capacity — admission order is the queue's order,
+            # not the lock's (the notify_all fairness fix). The decode
+            # loop preempts a lower-class slot at a window boundary
+            # when this ticket is head and cannot fit.
+            ticket = self._sched.enqueue_locked(req, priority,
+                                                pages_needed)
+            req.ticket_no = ticket.no
+            if (not self._free_slots
+                    or self._reserved + pages_needed
+                    > self._pages_total):
+                # Actually parking: kick the decode loop so the next
+                # boundary can consider preempting for this ticket.
+                # (The uncontended admit must NOT wake the loop — it
+                # adds nothing and perturbs the seed path's timing.)
+                self._work.notify_all()
+            try:
+                while True:
+                    if self._closed or self._draining:
+                        raise self._refusal()
+                    if req.cancelled:
+                        raise RequestCancelled(
+                            "request cancelled while queued for "
+                            "admission"
+                        )
+                    if (self._sched.head_locked() is ticket
+                            and self._free_slots
+                            and self._reserved + pages_needed
+                            <= self._pages_total):
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        hint = self._retry_hint()
+                        raise ServerBusy(
+                            "no slot/page capacity within the "
+                            f"timeout ({len(self._active)} requests "
+                            f"in flight; queue depth "
+                            f"[{self._sched.depth_text_locked()}]"
+                            + (f"; retry after ~{hint:.1f}s"
+                               if hint is not None else "") + ")"
+                        )
+                    ticket.cond.wait(timeout=remaining)
+                self._sched.admit_locked(ticket)
+                ticket = None  # admitted: the finally must not remove
+            finally:
+                if ticket is not None:
+                    self._sched.remove_locked(ticket)
+            req.admit_seq = self._sched.next_admit_seq_locked()
             slot = self._free_slots.pop()
             self._reserved += pages_needed
             # Prefix sharing: start the table on the cached prefix's
@@ -593,7 +718,17 @@ class PagedGenerationServer:
                 req.stream.put(failure)
             req.done.set()
         self._active.clear()
+        # Degraded mode reaches the swap set too (rung 14 x rung 17):
+        # a swapped-out request's device pages are gone and no healthy
+        # loop will ever resume it — fail it like an active one and
+        # free its host snapshot.
+        for entry in self._sched.take_swapped_locked():
+            entry.req.error = failure
+            if entry.req.stream is not None:
+                entry.req.stream.put(failure)
+            entry.req.done.set()
         self._closed = True
+        self._sched.wake_all_locked()
         self._work.notify_all()
 
     # ---- prefix sharing (lock held for every method here) ----------------
@@ -1040,6 +1175,9 @@ class PagedGenerationServer:
                 self._draining = True
             else:
                 self._closed = True
+            # Parked admission tickets wait on their OWN conditions —
+            # wake them all into the refusal path.
+            self._sched.wake_all_locked()
             self._work.notify_all()
         self._thread.join(timeout=600 if drain else 30)
         if not drain and self._thread.is_alive():
@@ -1051,6 +1189,7 @@ class PagedGenerationServer:
         if drain:
             with self._work:
                 self._closed = True
+                self._sched.wake_all_locked()
                 self._work.notify_all()
         # A slice-aware cache (runtime/sliceserve.py) releases its
         # followers here — under the lock, so the stop op serializes
@@ -1154,6 +1293,11 @@ class PagedGenerationServer:
             # (a slice cache's reform() already dropped its own).
             self._inflight = None
             self._cache.drop_carry()
+            # Scheduler scrub: swapped-out requests were already failed
+            # by _poison_locked (their snapshots freed); straggler
+            # tickets were woken into the refusal path. The queues
+            # restart empty; cumulative counters survive.
+            self._sched.reset_locked()
             self._poison = None
             self._degraded_reason = None
             self._closed = False
@@ -1189,6 +1333,9 @@ class PagedGenerationServer:
                 "window_host_ms": self._hist_host.snapshot(),
                 "window_inflight_depth": self._hist_depth.snapshot(),
             }
+            # Scheduler observability: per-class queue depth and wait
+            # histograms, preemption/resume/shed counters, swap gauges.
+            out.update(self._sched.stats_locked())
             if self._degraded_reason:
                 out["degraded_reason"] = self._degraded_reason
             if self._spec:
@@ -1217,6 +1364,10 @@ class PagedGenerationServer:
             self._cache.release(slot)
         self._free_slots.append(slot)
         self._reserved -= pages_needed
+        # Targeted admission wakeup: the policy head (and ONLY the
+        # head) re-checks capacity; the work condition still fans out
+        # to the decode loop (which may now resume a swapped request).
+        self._sched.wake_head_locked()
         self._work.notify_all()
 
     def _pages_needed(self, total: int, slack: bool) -> int:
@@ -1456,6 +1607,135 @@ class PagedGenerationServer:
                     req.stream.put(_STREAM_DONE)
                 req.done.set()
 
+    # ---- scheduler boundary hooks (SERVING.md rung 17) -------------------
+
+    def _sched_attention_locked(self, *,
+                                ignore_inflight: bool = False) -> bool:
+        """Does the decode loop need a non-overlapped boundary for the
+        scheduler (lock held)? True when the policy head could RESUME
+        right now, or is starved and a preemptable victim exists. A
+        head ticket that already fits is its own thread's job — no
+        boundary needed. ``ignore_inflight`` is the pipeline-collapse
+        variant: at the harvest-or-dispatch decision every active row
+        still carries in-flight window tokens, but the harvest that a
+        collapse implies reconciles them — so a victim is judged by
+        what it will be AT the boundary, not mid-window."""
+        head = self._sched.head_locked()
+        if head is None:
+            return False
+        if (self._free_slots
+                and self._reserved + head.pages_needed
+                <= self._pages_total):
+            return head.resume
+        return (self._sched.preemption_enabled
+                and self._pick_victim_locked(
+                    head, ignore_inflight=ignore_inflight) is not None)
+
+    def _swap_cost_locked(self, req: _Request) -> int:
+        """Host bytes req's swap snapshot would occupy (lock held) —
+        the budget check BEFORE paying the device gather."""
+        if self._swap_page_bytes is None:
+            st = self._cache.state
+            per = st.pool_k.nbytes + st.pool_v.nbytes
+            if st.scale_k is not None:
+                per += st.scale_k.nbytes + st.scale_v.nbytes
+            self._swap_page_bytes = -(-per // self._cache.num_pages)
+        n_pages = -(-(len(req.prompt) + len(req.generated))
+                    // self._cache.page_size)
+        return n_pages * self._swap_page_bytes
+
+    def _pick_victim_locked(self, head, *,
+                            ignore_inflight: bool = False) -> int | None:
+        """The slot to preempt for ``head``, or None: a STRICTLY
+        lower-class active request — never an equal (no intra-class
+        churn) — preferring the lowest class, then the LATEST admitted
+        (least progress lost), whose snapshot fits the host budget.
+        Rows with in-flight window tokens are skipped: preemption
+        joins only at reconciled boundaries (``ignore_inflight`` —
+        the pipeline-collapse probe — looks past tokens the imminent
+        harvest will reconcile)."""
+        head_rank = self._sched.rank(head.pclass)
+        best_slot, best_key = None, None
+        for slot, req in self._active.items():
+            if req.cancelled or (req.inflight and not ignore_inflight):
+                continue
+            rank = self._sched.rank(req.pclass)
+            if rank <= head_rank:
+                continue
+            if not self._sched.swap_fits_locked(
+                    self._swap_cost_locked(req)):
+                continue
+            key = (rank, req.admit_seq)
+            if best_key is None or key > best_key:
+                best_slot, best_key = slot, key
+        return best_slot
+
+    def _maybe_resume_locked(self) -> None:
+        """Re-admit swapped-out requests while the policy head is a
+        resume entry that fits (lock held, boundary only). Worst-case
+        reservation is re-acquired FIRST — the same invariant that
+        makes normal admission safe makes swap-in safe: once the
+        reservation is booked, ``admit`` + later ``grow`` can never
+        starve (registry pins are evictable on demand). The page bytes
+        go back verbatim (``swapin_pages`` — no dtype round trip), and
+        the positional key schedule plus the host-held
+        ``next_token``/``generated`` make the resumed stream
+        bit-identical to a never-preempted run."""
+        while True:
+            head = self._sched.head_locked()
+            if (head is None or not head.resume
+                    or not self._free_slots
+                    or self._reserved + head.pages_needed
+                    > self._pages_total):
+                return
+            arrays = head.arrays
+            self._sched.pop_resume_locked(head)
+            req = head.req
+            slot = self._free_slots.pop()
+            self._reserved += head.pages_needed
+            # Active BEFORE the device calls: if the swap-in faults,
+            # the poison path owns this waiter like any other.
+            self._active[slot] = req
+            self._cache.admit(slot, head.saved_len)
+            self._cache.swapin_pages(
+                self._cache.slot_pages(slot), arrays
+            )
+
+    def _maybe_preempt_locked(self) -> None:
+        """Swap out lower-class victims while the policy head is
+        starved for capacity (lock held, boundary only). The victim's
+        live pages — exactly ceil(len/page_size), as stored — move to
+        host RAM, its slot and reservation free, and a resume entry
+        under its ORIGINAL ticket re-enters the queue; the freed
+        capacity wakes the head ticket."""
+        if not self._sched.preemption_enabled:
+            return
+        while True:
+            head = self._sched.head_locked()
+            if head is None:
+                return
+            if (self._free_slots
+                    and self._reserved + head.pages_needed
+                    <= self._pages_total):
+                self._sched.wake_head_locked()
+                return
+            victim = self._pick_victim_locked(head)
+            if victim is None:
+                return
+            req = self._active[victim]
+            saved_len = len(req.prompt) + len(req.generated)
+            n_pages = -(-saved_len // self._cache.page_size)
+            # slot_pages is position-ordered; pages grown past the
+            # live length hold no committed K/V and are simply freed.
+            ids = self._cache.slot_pages(victim)[:n_pages]
+            arrays = self._cache.swapout_pages(ids)
+            del self._active[victim]
+            self._release_locked(victim, req.pages_reserved)
+            self._sched.record_swapout_locked(
+                req, req.pclass, req.ticket_no, req.pages_reserved,
+                saved_len, arrays,
+            )
+
     def _loop(self) -> None:
         step = (self._loop_once_overlap if self._overlap_on
                 else self._loop_once)
@@ -1479,14 +1759,17 @@ class PagedGenerationServer:
 
         with self._work:
             while (not self._active and not self._closed
+                   and not self._sched_attention_locked()
                    and not (self._draining
                             and not self._prefilling)):
                 self._work.wait()
             if (self._draining and not self._active
-                    and not self._prefilling):
+                    and not self._prefilling
+                    and not self._sched.resume_pending_locked()):
                 # Drained: every accepted request — including any
-                # whose chunked prefill was in flight when the
-                # drain began — has finished.
+                # whose chunked prefill was in flight when the drain
+                # began, and any swapped-out awaiting resume — has
+                # finished.
                 return "exit"
             if self._closed:
                 for req in self._active.values():
@@ -1496,10 +1779,15 @@ class PagedGenerationServer:
                         req.stream.put(req.error)
                     req.done.set()
                 self._active.clear()
+                self._fail_swapped_closed_locked()
                 return "exit"
             try:
                 self._sweep_cancelled_locked()
                 self._sweep_finished_locked()
+                # Scheduler boundary: resume swapped-out requests into
+                # freed capacity, then preempt for a starved head.
+                self._maybe_resume_locked()
+                self._maybe_preempt_locked()
                 if not self._active:
                     return "ran"
                 if (self._spec > 0
@@ -1598,12 +1886,14 @@ class PagedGenerationServer:
         with self._work:
             while (not self._active and self._inflight is None
                    and not self._closed
+                   and not self._sched_attention_locked()
                    and not (self._draining
                             and not self._prefilling)):
                 self._work.wait()
             if (self._draining and not self._active
                     and self._inflight is None
-                    and not self._prefilling):
+                    and not self._prefilling
+                    and not self._sched.resume_pending_locked()):
                 return "exit"
             if self._closed:
                 # Hard close: abandon the in-flight window unforced
@@ -1621,11 +1911,18 @@ class PagedGenerationServer:
                         req.stream.put(req.error)
                     req.done.set()
                 self._active.clear()
+                self._fail_swapped_closed_locked()
                 return "exit"
             try:
                 if self._inflight is None:
                     self._sweep_cancelled_locked()
                     self._sweep_finished_locked()
+                    # Preemption/resume join ONLY here — the
+                    # non-overlapped boundary, where every row's
+                    # tokens are reconciled and cache state is
+                    # quiescent.
+                    self._maybe_resume_locked()
+                    self._maybe_preempt_locked()
                     if not self._active:
                         return "ran"
                     if (self._spec > 0
@@ -1673,12 +1970,28 @@ class PagedGenerationServer:
         be honored, or when a slot is active that the in-flight window
         never dispatched (a newcomer admission — it may only join at a
         boundary, where its first token is host-known; the carry row
-        of a slot that sat out the previous window is garbage)."""
+        of a slot that sat out the previous window is garbage). The
+        scheduler adds a third reason: a resumable or starved-but-
+        preemptable head collapses the pipeline to a boundary, where
+        the swap may join."""
         dispatched = {slot for slot, _, _ in prev["parts"]}
         for slot, req in self._active.items():
             if req.cancelled or slot not in dispatched:
                 return True
-        return False
+        return self._sched_attention_locked(ignore_inflight=True)
+
+    def _fail_swapped_closed_locked(self) -> None:
+        """Hard close reaches the swap set like the active set: a
+        swapped-out request will never be resumed by an exiting loop —
+        fail its waiter and free the host snapshot."""
+        for entry in self._sched.take_swapped_locked():
+            entry.req.error = ServerClosed(
+                "server shut down mid-request (swapped out)"
+            )
+            if entry.req.stream is not None:
+                entry.req.stream.put(entry.req.error)
+            entry.req.done.set()
+        self._sched.wake_all_locked()
 
     def _dispatch_window_locked(self, first: bool) -> dict | None:
         """Enqueue one capped window for every active slot with budget

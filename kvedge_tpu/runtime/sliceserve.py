@@ -67,11 +67,13 @@ from kvedge_tpu.models.kvcache import (
     PagedKVCache,
     PagedState,
     _decode_step_core,
+    _gather_pages_impl,
     _paged_decode_window_capped_impl,
     _paged_decode_window_impl,
     _paged_decode_window_sampled_capped_impl,
     _paged_decode_window_sampled_impl,
     _paged_prefill_impl,
+    _scatter_pages_impl,
     _spec_verify_core,
 )
 
@@ -82,7 +84,7 @@ from kvedge_tpu.models.kvcache import (
 # block on a result (they never read tokens at all). New codes append
 # at the end: the numbering is wire protocol.
 (OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW, OP_SPEC,
- OP_WSAMPLE, OP_WINDOWP, OP_WSAMPLEP) = range(9)
+ OP_WSAMPLE, OP_WINDOWP, OP_WSAMPLEP, OP_SWAPOUT, OP_SWAPIN) = range(11)
 _HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
 
 
@@ -149,8 +151,18 @@ def _slice_kernels(mesh, cfg, quantized: bool = False):
         static_argnames=("cfg", "n_steps"), donate_argnums=(1,),
         out_shardings=(rep, state_sh),
     )
+    # Preemptive swap (SERVING.md rung 17): the gather pins REPLICATED
+    # outputs — an all-gather over the model-sharded pool dims, so the
+    # leader can host-read the as-stored page bytes; the scatter takes
+    # replicated page bytes back into the sharded pools (each process
+    # keeps its own head shard of the update). No dtype conversion in
+    # either — the swap path's bit-exactness contract.
+    swap_gather = jax.jit(_gather_pages_impl, out_shardings=rep)
+    swap_scatter = jax.jit(
+        _scatter_pages_impl, donate_argnums=(0,), out_shardings=state_sh,
+    )
     return (rep, state_sh, prefill, step, window, spec, wsample,
-            window_capped, wsample_capped)
+            window_capped, wsample_capped, swap_gather, swap_scatter)
 
 
 class SlicePagedKVCache(PagedKVCache):
@@ -187,7 +199,8 @@ class SlicePagedKVCache(PagedKVCache):
         self.mesh = mesh
         (self._rep, self._state_sh, self._k_prefill, self._k_step,
          self._k_window, self._k_spec, self._k_wsample,
-         self._k_window_capped, self._k_wsample_capped) = _slice_kernels(
+         self._k_window_capped, self._k_wsample_capped,
+         self._k_swapout, self._k_swapin) = _slice_kernels(
              mesh, cfg, quantized=kv_dtype == "int8"
          )
         self._is_leader = jax.process_index() == 0
@@ -532,6 +545,67 @@ class SlicePagedKVCache(PagedKVCache):
         self._check_live()
         return self._ops.run(("wharvest",), lambda: self._read(handle))
 
+    # ---- preemptive swap (scheduler, SERVING.md rung 17) -----------------
+
+    def _device_swapout(self, ids):
+        """Leader: broadcast the page ids, then every process runs the
+        same jitted gather — an all-gather over the model-sharded pool
+        dims whose replicated result the leader reads host-side. The
+        follower replays the op in the totally-ordered stream and
+        discards its (identical) copy."""
+        self._check_live()
+        ids_np = np.asarray(ids, np.int32)
+
+        def op():
+            self._send_header(OP_SWAPOUT, ids_np.shape[0])
+            sent = np.asarray(self._bcast(ids_np))
+            return self._exec_swapout(sent)
+
+        return self._ops.run(("swapout", ids_np.shape[0]), op)
+
+    def _exec_swapout(self, ids: np.ndarray):
+        out = self._k_swapout(
+            self.state, self._global(ids.astype(np.int32))
+        )
+        return tuple(self._read(x) for x in out)
+
+    def _device_swapin(self, ids, arrays) -> None:
+        """Leader: broadcast ids + the as-stored page bytes, then every
+        process scatters them back into its own shard of the pools.
+        The snapshot rides the op stream by value, like every other
+        device input — followers hold no swap state between ops."""
+        self._check_live()
+        ids_np = np.asarray(ids, np.int32)
+        arrs = tuple(np.asarray(a) for a in arrays)
+
+        def op():
+            self._send_header(OP_SWAPIN, ids_np.shape[0])
+            payload = [np.asarray(x)
+                       for x in self._bcast((ids_np,) + arrs)]
+            self._exec_swapin(payload[0], tuple(payload[1:]))
+
+        self._ops.run(("swapin", ids_np.shape[0]), op)
+
+    def _exec_swapin(self, ids: np.ndarray, arrays: tuple) -> None:
+        self.state = self._k_swapin(
+            self.state, self._global(ids.astype(np.int32)),
+            tuple(self._global(a) for a in arrays),
+        )
+
+    def _swap_templates(self, n: int) -> tuple:
+        """Follower zero templates for an OP_SWAPIN payload of ``n``
+        pages: shapes/dtypes must match the leader's broadcast exactly
+        (as stored — [L, n, page, K, Dh] pools plus fp32 scale slabs
+        for an int8 pool)."""
+        pk = self.state.pool_k
+        shape = (pk.shape[0], n) + tuple(pk.shape[2:])
+        out = [np.zeros((n,), np.int32),
+               np.zeros(shape, pk.dtype), np.zeros(shape, pk.dtype)]
+        if self.kv_quantized:
+            out += [np.zeros(shape[:-1], np.float32),
+                    np.zeros(shape[:-1], np.float32)]
+        return tuple(out)
+
     def _device_spec(self, params, tokens, active, spec_mask):
         self._check_live()
         tokens = np.asarray(tokens, np.int32)
@@ -728,6 +802,16 @@ class SlicePagedKVCache(PagedKVCache):
                 params, *(np.asarray(x) for x in payload), n_steps=a,
                 carry=bool(c),
             )
+        elif op == OP_SWAPOUT:
+            # a = page count. The gather's replicated result is
+            # discarded — only the leader's host copy becomes the
+            # snapshot; the follower just joins the collective.
+            ids = self._bcast(np.zeros((a,), np.int32))
+            self._exec_swapout(np.asarray(ids))
+        elif op == OP_SWAPIN:
+            payload = [np.asarray(x)
+                       for x in self._bcast(self._swap_templates(a))]
+            self._exec_swapin(payload[0], tuple(payload[1:]))
         else:  # pragma: no cover - protocol corruption is slice-fatal
             raise PagedCacheError(f"unknown slice-serve op {op}")
         return True

@@ -137,12 +137,42 @@ _SERVE_METRIC_FIELDS = (
     ("last_recovery_s", "serve_last_recovery_seconds", "gauge",
      "wall-clock seconds the most recent successful recovery took "
      "(also the basis of the degraded-refusal retry-after hint)"),
+    # SLO-aware admission scheduler (models/scheduler.py, SERVING.md
+    # rung 17): per-class queue depth, the preemptive-swap ledger, and
+    # the shed counter the overload watermarks drive.
+    ("sched_queue_depth_interactive", "serve_sched_queue_depth_interactive",
+     "gauge",
+     "interactive-class requests parked in the admission queue "
+     "(paged backend)"),
+    ("sched_queue_depth_batch", "serve_sched_queue_depth_batch", "gauge",
+     "batch-class requests parked in the admission queue "
+     "(paged backend)"),
+    ("sched_swapped_out", "serve_sched_swapped_out", "gauge",
+     "preempted requests whose KV pages currently live in host RAM "
+     "awaiting resume (paged backend)"),
+    ("sched_swap_bytes_host", "serve_sched_swap_bytes_host", "gauge",
+     "host RAM bytes held by swapped-out KV snapshots, counted "
+     "against serving_sched_swap_budget_mb (paged backend)"),
+    ("sched_preemptions_total", "serve_sched_preemptions_total",
+     "counter",
+     "requests preempted (KV swapped to host) to admit a "
+     "higher-class request (paged backend)"),
+    ("sched_resumes_total", "serve_sched_resumes_total", "counter",
+     "preempted requests swapped back in and resumed — matches "
+     "preemptions at idle unless a failure dropped the swap set "
+     "(paged backend)"),
+    ("sched_shed_total", "serve_sched_shed_total", "counter",
+     "requests rejected early by the overload watermarks "
+     "(serving_sched_max_queue_depth / _wait_s) with a measured "
+     "retry-after hint (paged backend)"),
 )
 
-# Per-window latency histograms from the overlapped decode loop
-# (models/serving.py _Hist snapshots: {"edges", "counts", "sum",
-# "count"} with per-bucket counts — cumulated into Prometheus ``le``
-# buckets here, at render time).
+# Latency histograms from the serving path (models/scheduler.py _Hist
+# snapshots: {"edges", "counts", "sum", "count"} with per-bucket
+# counts — cumulated into Prometheus ``le`` buckets here, at render
+# time). The window_* series come from the overlapped decode loop, the
+# sched_* series from the admission scheduler's per-class queue-wait
+# tracking.
 _SERVE_HISTOGRAM_FIELDS = (
     # (serving key, metric suffix, help text)
     ("window_dispatch_harvest_ms", "serve_window_dispatch_harvest_ms",
@@ -154,6 +184,13 @@ _SERVE_HISTOGRAM_FIELDS = (
     ("window_inflight_depth", "serve_window_inflight_depth",
      "pipeline depth observed at each window dispatch (0 = boundary "
      "dispatch, 1 = overlapped dispatch)"),
+    ("sched_queue_wait_ms_interactive",
+     "serve_sched_queue_wait_ms_interactive",
+     "admission queue wait in ms for interactive-class requests "
+     "(enqueue to admit or resume)"),
+    ("sched_queue_wait_ms_batch", "serve_sched_queue_wait_ms_batch",
+     "admission queue wait in ms for batch-class requests "
+     "(enqueue to admit or resume)"),
 )
 
 

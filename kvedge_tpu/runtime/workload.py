@@ -803,7 +803,7 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
     stopped = False
 
     def _serve(doc: dict) -> dict:
-        tokens, n_new, temperature, top_p, seed, stream, spec = (
+        tokens, n_new, temperature, top_p, seed, stream, spec, _, _ = (
             _parse_generate_request(doc, tcfg, max_rows=max_rows,
                                     paged=False)
         )
@@ -1011,8 +1011,9 @@ def _parse_generate_request(doc: dict, tcfg, *, max_rows: int,
     """Validate a ``POST /generate`` body. ONE definition shared by the
     single-host serve path and the multi-host leader (the two must never
     drift on what a well-formed request is). Returns
-    ``(tokens, n_new, temperature, top_p, seed, stream, spec)``; raises
-    ``ValueError`` (the HTTP layer's 400) for anything malformed.
+    ``(tokens, n_new, temperature, top_p, seed, stream, spec, priority,
+    deadline_ms)``; raises ``ValueError`` (the HTTP layer's 400) for
+    anything malformed.
     """
     tokens = doc.get("tokens")
     if (not isinstance(tokens, list) or not tokens
@@ -1123,7 +1124,31 @@ def _parse_generate_request(doc: dict, tcfg, *, max_rows: int,
                 "'speculative' is greedy-only (temperature 0): "
                 "drafts verify against the argmax"
             )
-    return tokens, n_new, temperature, top_p, seed, stream, spec
+    # SLO fields (SERVING.md rung 17): 'priority' names the admission
+    # class, 'deadline_ms' bounds how long the request may queue. The
+    # paged server validates the class name against its configured set
+    # (an unknown class is this same 400 path); the contiguous backend
+    # has no admission queue, so the fields are refused there rather
+    # than silently ignored.
+    priority = doc.get("priority", "interactive")
+    if not isinstance(priority, str) or not priority:
+        raise ValueError(
+            "'priority' must be a non-empty class name "
+            "(e.g. 'interactive' or 'batch')"
+        )
+    deadline_ms = doc.get("deadline_ms")
+    if deadline_ms is not None and (
+            not isinstance(deadline_ms, int)
+            or isinstance(deadline_ms, bool) or deadline_ms < 1):
+        raise ValueError("'deadline_ms' must be a positive integer")
+    if not paged and ("priority" in doc or deadline_ms is not None):
+        raise ValueError(
+            "'priority'/'deadline_ms' require [payload] serving = "
+            "\"paged\" — the contiguous backend runs one request at a "
+            "time with no admission queue to schedule"
+        )
+    return (tokens, n_new, temperature, top_p, seed, stream, spec,
+            priority, deadline_ms)
 
 
 def run_serve_payload(cfg: RuntimeConfig):
@@ -1244,6 +1269,16 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 kv_dtype=cfg.serving_kv_dtype,
                 cache=cache,
                 retry_after_s=cfg.serving_retry_after_s,
+                # SLO-aware admission (SERVING.md rung 17): policy +
+                # watermarks + host swap budget from the [payload]
+                # serving_sched_* knobs; weights pre-parsed so a bad
+                # string fails at config validation, not first request.
+                sched_policy=cfg.serving_sched_policy,
+                sched_weights=cfg.sched_weights_dict(),
+                sched_max_queue_depth=cfg.serving_sched_max_queue_depth,
+                sched_max_queue_wait_s=(
+                    cfg.serving_sched_max_queue_wait_s),
+                sched_swap_budget_mb=cfg.serving_sched_swap_budget_mb,
                 # Overlapped window pipeline ([payload]
                 # serving_overlap). Multi-host note: revive() after a
                 # recovery restarts _loop, which re-selects the
@@ -1367,7 +1402,8 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
         lock = threading.Lock()
 
         def _serve(doc: dict) -> dict:
-            tokens, n_new, temperature, top_p, seed, stream, spec = (
+            (tokens, n_new, temperature, top_p, seed, stream, spec,
+             priority, deadline_ms) = (
                 _parse_generate_request(
                     doc, tcfg, max_rows=max_rows,
                     paged=paged_server is not None,
@@ -1462,7 +1498,8 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
 
                     def prime(i):
                         src = paged_server.submit_stream(
-                            prompts[i], n_new, sampling=row_sampling(i)
+                            prompts[i], n_new, sampling=row_sampling(i),
+                            priority=priority, deadline_ms=deadline_ms,
                         )
                         firsts[i] = next(src)
                         sources[i] = src
@@ -1547,6 +1584,7 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                     rows[i] = paged_server.submit(
                         [t % tcfg.vocab for t in tokens[i]], n_new,
                         sampling=row_sampling(i),
+                        priority=priority, deadline_ms=deadline_ms,
                     )
 
                 fan_out_rows(len(tokens), one_row)

@@ -169,6 +169,19 @@ class FaultyCache(PagedKVCache):
         self._seam("spec")
         return super()._device_spec(params, tokens, active, spec_mask)
 
+    # Preemptive-swap seams (models/scheduler.py, SERVING.md rung 17):
+    # a swap-out dies with the victim's pages still intact on device
+    # (the poison path must not leak its snapshot-to-be); a swap-in
+    # dies after the resume reservation was re-booked (revive must
+    # return the pool to the idle fixpoint regardless).
+    def _device_swapout(self, ids):
+        self._seam("swapout")
+        return super()._device_swapout(ids)
+
+    def _device_swapin(self, ids, arrays):
+        self._seam("swapin")
+        return super()._device_swapin(ids, arrays)
+
     # Overlapped-pipeline seams (models/serving.py _loop_once_overlap):
     # dispatch and harvest are SEPARATE failure boundaries now — a
     # dispatch can die while an earlier window is still in flight, and

@@ -33,6 +33,10 @@ Usage::
     python tools/run_tests.py --overlap  # only the overlapped-window
                                          # exactness tests (-m overlap);
                                          # fast, also tier-1
+    python tools/run_tests.py --sched    # only the admission-scheduler
+                                         # tests (-m sched: priority,
+                                         # preemptive swap, shedding);
+                                         # fast, also tier-1
     python tools/run_tests.py --list     # show the shard plan only
 
 Prints a per-shard progress line and ONE aggregate summary; exits 0
@@ -164,6 +168,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--overlap", action="store_true",
                     help="run only the overlapped-window pipeline "
                          "exactness tests (forwards -m overlap)")
+    ap.add_argument("--sched", action="store_true",
+                    help="run only the admission-scheduler tests "
+                         "(forwards -m sched)")
     ap.add_argument("pytest_args", nargs="*",
                     help="extra args forwarded to pytest (e.g. -k expr)")
     args, unknown = ap.parse_known_args(argv)
@@ -174,6 +181,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "recovery"]
     if args.overlap:
         args.pytest_args += ["-m", "overlap"]
+    if args.sched:
+        args.pytest_args += ["-m", "sched"]
 
     counts = collect_counts(args.pytest_args)
     if not counts:
