@@ -42,7 +42,13 @@ mutation) into controlled behavior under contention. Three pillars:
   hint (an EWMA of recent per-class admission waits), instead of
   letting every caller burn its full timeout. A request whose own
   deadline is provably unmeetable (estimated wait exceeds
-  ``deadline_ms``) is shed the same way.
+  ``deadline_ms``) is shed the same way. Two guards keep shedding
+  honest: the depth watermark only counts tickets the policy would
+  actually serve ahead of the arrival (a parked batch flood must not
+  shed an interactive request it cannot delay), and the wait estimate
+  ages toward zero between admissions — shed requests never enqueue,
+  so without decay a transient spike would freeze the EWMA above the
+  watermark and shed a class forever on an idle server.
 
 The scheduler is pure policy + bookkeeping: it raises no serving
 exceptions and touches no cache state. Every method that ends in
@@ -189,11 +195,22 @@ class AdmissionScheduler:
         # deterministic and stable between admissions.
         self._served = {c: 0 for c in self.classes}
         # Measured queue wait per class (seconds, EWMA) — the shed
-        # watermark input and the retry_after hint.
+        # watermark input and the retry_after hint — plus the time of
+        # the class's last admission, which ages the estimate: shed
+        # decisions happen BEFORE enqueue, so a shed request never
+        # feeds a sample back, and an undecayed estimate would keep
+        # shedding long after the overload passed.
         self._wait_ewma: dict[str, float | None] = {
             c: None for c in self.classes
         }
+        self._last_admit = {c: time.monotonic() for c in self.classes}
         self._hist_wait = {c: _Hist(_WAIT_EDGES_MS)
+                           for c in self.classes}
+        # Swap residency (swap-out to resume) is observed separately:
+        # folding it into the queue-wait histogram would inflate the
+        # admission p99 operators read, while the EWMA deliberately
+        # excludes it — the two consumers must measure the same thing.
+        self._hist_swap = {c: _Hist(_WAIT_EDGES_MS)
                            for c in self.classes}
         # Host bytes currently held by swap snapshots.
         self.swap_bytes = 0
@@ -279,6 +296,40 @@ class AdmissionScheduler:
 
     # ---- overload shedding -----------------------------------------------
 
+    def wait_estimate_locked(self, pclass: str) -> float | None:
+        """Measured queue wait for ``pclass``, aged for staleness.
+
+        Shed rejections happen BEFORE enqueue, so a shed request never
+        admits and never feeds a sample back into the EWMA. Without
+        decay, a transient overload that drains would freeze the
+        estimate above the watermark and shed the class forever on an
+        idle server (and spuriously fail the deadline check for
+        requests that would admit instantly). Instead the raw EWMA is
+        aged from the class's last admission: unchanged for one
+        estimate-width of silence, then halving per estimate-width."""
+        est = self._wait_ewma[pclass]
+        if not est:
+            return est
+        age = time.monotonic() - self._last_admit[pclass]
+        if age > est:
+            est *= 0.5 ** (age / est - 1.0)
+        return est
+
+    def shed_depth_locked(self, pclass: str) -> int:
+        """Parked tickets the depth watermark weighs against a
+        ``pclass`` arrival. Under ``fifo`` every ticket is ahead of
+        it; under ``strict``/``weighted`` parked work of strictly
+        lower classes cannot hold it back (the policy serves the
+        better rank first), so counting it would let a flood of parked
+        batch requests shed an interactive arrival the policy would
+        admit ahead of all of them — priority inversion in the
+        shedding path."""
+        if self.policy == "fifo":
+            return self.depth_locked()
+        r = self.rank(pclass)
+        return sum(self.depth_locked(c) for c in self.classes
+                   if self._rank[c] <= r)
+
     def shed_check_locked(self, pclass: str,
                           deadline_ms: int | None) -> dict | None:
         """Reject-early decision BEFORE enqueue. Returns None (admit to
@@ -286,13 +337,22 @@ class AdmissionScheduler:
         layer turns the latter into a typed refusal carrying the
         measured hint (satellite 2), so an overloaded server costs a
         client one RTT, not its full timeout."""
-        est = self._wait_ewma[pclass]
-        if self.max_queue_depth and self.depth_locked() >= self.max_queue_depth:
+        est = self.wait_estimate_locked(pclass)
+        depth = self.shed_depth_locked(pclass)
+        if self.max_queue_depth and depth >= self.max_queue_depth:
             self.shed += 1
             return {"reason": f"admission queue is full "
-                              f"(depth {self.depth_locked()} >= "
-                              f"watermark {self.max_queue_depth})",
+                              f"({depth} tickets ahead of class "
+                              f"{pclass!r} >= watermark "
+                              f"{self.max_queue_depth})",
                     "retry_after_s": est}
+        # Wait-based sheds only apply while same-class work is parked:
+        # with an empty class queue the arrival becomes the class head
+        # immediately, and letting it park is the only way the wait
+        # estimate ever gets a fresh sample (shed requests never
+        # admit) — the second half of the anti-livelock guard.
+        if self.depth_locked(pclass) == 0:
+            return None
         if self.max_queue_wait_s and est is not None \
                 and est > self.max_queue_wait_s:
             self.shed += 1
@@ -329,13 +389,15 @@ class AdmissionScheduler:
         weighted policy, and wake whoever is head now."""
         self._remove(entry)
         self._served[entry.pclass] += 1
-        wait = time.monotonic() - entry.enqueued_at
+        now = time.monotonic()
+        wait = now - entry.enqueued_at
         self._hist_wait[entry.pclass].observe(wait * 1000.0)
         prev = self._wait_ewma[entry.pclass]
         self._wait_ewma[entry.pclass] = (
             wait if prev is None
             else (1 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * wait
         )
+        self._last_admit[entry.pclass] = now
         self.wake_head_locked()
 
     def remove_locked(self, entry: _Entry) -> None:
@@ -392,14 +454,18 @@ class AdmissionScheduler:
 
     def pop_resume_locked(self, entry: _Entry) -> None:
         """The decode loop re-admitted a swapped request: drop the host
-        snapshot accounting and charge the policy like any admission."""
+        snapshot accounting and charge the policy like any admission.
+        The swapped-out residency (``enqueued_at`` was reset at
+        swap-out) goes to its OWN histogram: it is not an admission
+        wait, and the queue-wait histogram must keep measuring the same
+        thing the EWMA does."""
         self._remove(entry)
         self.swap_bytes -= entry.nbytes
         entry.arrays = ()
         self._served[entry.pclass] += 1
         self.resumes += 1
         wait = time.monotonic() - entry.enqueued_at
-        self._hist_wait[entry.pclass].observe(wait * 1000.0)
+        self._hist_swap[entry.pclass].observe(wait * 1000.0)
         self.wake_head_locked()
 
     def drop_swapped_locked(self, req) -> _Entry | None:
@@ -457,5 +523,8 @@ class AdmissionScheduler:
             out[f"sched_queue_depth_{c}"] = self.depth_locked(c)
             out[f"sched_queue_wait_ms_{c}"] = (
                 self._hist_wait[c].snapshot()
+            )
+            out[f"sched_swap_residency_ms_{c}"] = (
+                self._hist_swap[c].snapshot()
             )
         return out

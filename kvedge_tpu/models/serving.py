@@ -1631,17 +1631,25 @@ class PagedGenerationServer:
                 and self._pick_victim_locked(
                     head, ignore_inflight=ignore_inflight) is not None)
 
-    def _swap_cost_locked(self, req: _Request) -> int:
+    def _swap_cost_locked(self, req: _Request, *,
+                          include_inflight: bool = False) -> int:
         """Host bytes req's swap snapshot would occupy (lock held) —
-        the budget check BEFORE paying the device gather."""
+        the budget check BEFORE paying the device gather.
+        ``include_inflight`` prices the snapshot AS OF the next
+        reconciled boundary (live length + in-flight window tokens):
+        the pipeline-collapse probe must predict the boundary-time
+        cost, or it can collapse the pipeline for a victim whose
+        grown snapshot the budget then declines — a wasted collapse."""
         if self._swap_page_bytes is None:
             st = self._cache.state
             per = st.pool_k.nbytes + st.pool_v.nbytes
             if st.scale_k is not None:
                 per += st.scale_k.nbytes + st.scale_v.nbytes
             self._swap_page_bytes = -(-per // self._cache.num_pages)
-        n_pages = -(-(len(req.prompt) + len(req.generated))
-                    // self._cache.page_size)
+        n_tokens = len(req.prompt) + len(req.generated)
+        if include_inflight:
+            n_tokens += req.inflight
+        n_pages = -(-n_tokens // self._cache.page_size)
         return n_pages * self._swap_page_bytes
 
     def _pick_victim_locked(self, head, *,
@@ -1663,7 +1671,8 @@ class PagedGenerationServer:
             if rank <= head_rank:
                 continue
             if not self._sched.swap_fits_locked(
-                    self._swap_cost_locked(req)):
+                    self._swap_cost_locked(
+                        req, include_inflight=ignore_inflight)):
                 continue
             key = (rank, req.admit_seq)
             if best_key is None or key > best_key:

@@ -187,10 +187,18 @@ _SERVE_HISTOGRAM_FIELDS = (
     ("sched_queue_wait_ms_interactive",
      "serve_sched_queue_wait_ms_interactive",
      "admission queue wait in ms for interactive-class requests "
-     "(enqueue to admit or resume)"),
+     "(enqueue to admit; swap residency is tracked separately)"),
     ("sched_queue_wait_ms_batch", "serve_sched_queue_wait_ms_batch",
      "admission queue wait in ms for batch-class requests "
-     "(enqueue to admit or resume)"),
+     "(enqueue to admit; swap residency is tracked separately)"),
+    ("sched_swap_residency_ms_interactive",
+     "serve_sched_swap_residency_ms_interactive",
+     "time preempted interactive-class requests spent swapped out to "
+     "host RAM in ms (swap-out to resume)"),
+    ("sched_swap_residency_ms_batch",
+     "serve_sched_swap_residency_ms_batch",
+     "time preempted batch-class requests spent swapped out to "
+     "host RAM in ms (swap-out to resume)"),
 )
 
 

@@ -214,16 +214,21 @@ def test_preempt_resume_on_slice_cache_is_exact(params):
 def test_same_class_waiters_admit_in_arrival_order(params):
     """Two same-class waiters must admit in ARRIVAL order. Under the
     old Condition.notify_all herd, admission order was whatever the
-    lock handed out; the ticketed queue makes it the queue's order."""
+    lock handed out; the ticketed queue makes it the queue's order.
+    The assertion reads each request's admit_seq (assigned under the
+    lock at admission) rather than thread completion order, which a
+    loaded machine can invert by starving the earlier waiter's thread
+    after its decode already finished."""
     server = sched_server(params, sched_swap_budget_mb=0)
-    order = []
+    seqs = {}
     try:
         occ = server.submit_stream([7, 7, 7], n_new=30)
         next(occ)
 
         def worker(tag, prompt):
-            server.submit(prompt, n_new=2)
-            order.append(tag)
+            h = server.submit_stream(prompt, n_new=2)
+            list(h)
+            seqs[tag] = h._req.admit_seq
 
         a = threading.Thread(target=worker, args=("A", [1, 2]))
         a.start()
@@ -235,7 +240,7 @@ def test_same_class_waiters_admit_in_arrival_order(params):
         a.join(timeout=120)
         b.join(timeout=120)
         assert not a.is_alive() and not b.is_alive()
-        assert order == ["A", "B"]
+        assert seqs["A"] < seqs["B"]
     finally:
         server.close()
 
@@ -243,16 +248,20 @@ def test_same_class_waiters_admit_in_arrival_order(params):
 def test_strict_policy_admits_interactive_before_earlier_batch(params):
     """Across classes the strict policy inverts arrival order: an
     interactive request that arrives AFTER a parked batch request
-    admits first (no preemption needed — just the queue head)."""
+    admits first (no preemption needed — just the queue head).
+    Asserted on admit_seq, not thread completion order (see
+    test_same_class_waiters_admit_in_arrival_order)."""
     server = sched_server(params, sched_swap_budget_mb=0)
-    order = []
+    seqs = {}
     try:
         occ = server.submit_stream([7, 7, 7], n_new=30)
         next(occ)
 
         def worker(tag, prompt, priority):
-            server.submit(prompt, n_new=2, priority=priority)
-            order.append(tag)
+            h = server.submit_stream(prompt, n_new=2,
+                                     priority=priority)
+            list(h)
+            seqs[tag] = h._req.admit_seq
 
         b = threading.Thread(target=worker,
                              args=("batch", [1, 2], "batch"))
@@ -266,7 +275,7 @@ def test_strict_policy_admits_interactive_before_earlier_batch(params):
         occ.cancel()
         b.join(timeout=120)
         i.join(timeout=120)
-        assert order == ["interactive", "batch"]
+        assert seqs["interactive"] < seqs["batch"]
     finally:
         server.close()
 
@@ -526,6 +535,89 @@ def test_weighted_policy_shares_deterministically():
                         "batch", "interactive", "interactive",
                         "interactive", "batch"]
     assert sched.head_locked() is None
+
+
+def test_stale_wait_estimate_decays_instead_of_shedding_forever():
+    """Regression (shed livelock): shed requests never enqueue, so
+    nothing feeds the EWMA after a transient spike — the estimate must
+    not freeze above the watermark and shed the class forever. Two
+    guards: wait/deadline sheds are bypassed while the class queue is
+    empty (the arrival would be head immediately, and admitting it is
+    the only source of fresh samples), and the estimate ages toward
+    zero from the last admission."""
+    sched = _mk("strict", max_queue_wait_s=0.5)
+    now = time.monotonic()
+    sched._wait_ewma["interactive"] = 4.0  # frozen post-spike estimate
+    sched._last_admit["interactive"] = now
+    # Empty class queue: never shed on the wait/deadline watermarks,
+    # no matter how high the stale estimate reads.
+    assert sched.shed_check_locked("interactive", None) is None
+    assert sched.shed_check_locked("interactive", 100) is None
+    # With a parked same-class waiter the fresh estimate DOES shed...
+    _park(sched, "interactive")
+    assert sched.shed_check_locked("interactive", None) is not None
+    # ...but ages toward zero without admissions: one estimate-width
+    # of grace, then halving per estimate-width (4s estimate, 40s of
+    # silence -> 4 * 0.5^9 ~ 8ms), so the shed ends on its own.
+    sched._last_admit["interactive"] = now - 40.0
+    est = sched.wait_estimate_locked("interactive")
+    assert est is not None and est < 0.5
+    assert sched.shed_check_locked("interactive", None) is None
+    assert sched.shed_check_locked("interactive", 100) is None
+    assert sched.shed == 1
+
+
+def test_depth_watermark_counts_only_classes_ahead():
+    """Regression (priority inversion in shedding): a flood of parked
+    batch tickets must not trip the depth watermark for an interactive
+    arrival that strict policy would admit ahead of all of them —
+    only tickets at or above the arrival's class count. Under fifo
+    every ticket is genuinely ahead, so the global depth applies."""
+    sched = _mk("strict", max_queue_depth=2)
+    for _ in range(3):
+        _park(sched, "batch")
+    assert sched.shed_check_locked("interactive", None) is None
+    assert sched.shed_check_locked("batch", None) is not None
+    fifo = _mk("fifo", max_queue_depth=2)
+    for _ in range(3):
+        _park(fifo, "batch")
+    assert fifo.shed_check_locked("interactive", None) is not None
+
+
+def test_swap_residency_has_its_own_histogram():
+    """Swapped-out residency (enqueued_at resets at swap-out) must not
+    inflate the admission queue-wait histogram the EWMA mirrors — it
+    lands in sched_swap_residency_ms instead."""
+    sched = _mk("strict", swap_budget_mb=1)
+    with sched._lock:
+        early = _park(sched, "batch")
+        req = early.req
+        sched.remove_locked(early)
+        entry = sched.record_swapout_locked(
+            req, "batch", early.no, pages_needed=2, saved_len=8,
+            arrays=(np.zeros((4,), np.int8),),
+        )
+        sched.pop_resume_locked(entry)
+        stats = sched.stats_locked()
+    assert stats["sched_queue_wait_ms_batch"]["count"] == 0
+    assert stats["sched_swap_residency_ms_batch"]["count"] == 1
+
+
+def test_frozen_high_wait_estimate_does_not_livelock(params):
+    """Server-level livelock regression: an idle server whose EWMA was
+    left high by a drained transient must still admit new requests
+    (and their admissions are what refresh the estimate)."""
+    server = sched_server(params, sched_max_queue_wait_s=0.1)
+    try:
+        with server._lock:
+            server._sched._wait_ewma["interactive"] = 60.0
+        prompt = [1, 2]
+        assert server.submit(prompt, n_new=3) == reference(
+            params, prompt, 3
+        )
+        assert server.stats()["sched_shed_total"] == 0
+    finally:
+        server.close()
 
 
 def test_resume_entry_keeps_original_ticket_order():
