@@ -116,6 +116,20 @@ def _parse_speculative(value):
     return int(value)
 
 
+def _parse_trace(value):
+    """``serving_trace``: "off"/"on" or a per-request sample rate in
+    (0, 1]. Type errors surface in validate() with the full
+    accepted-values message."""
+    if isinstance(value, str):
+        return value  # validate() accepts only "off"/"on"
+    if isinstance(value, bool):
+        raise RuntimeConfigError(
+            "[payload] serving_trace must be 'off', 'on' or a sample "
+            "rate in (0, 1] — not a boolean"
+        )
+    return float(value)
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelSpec:
     """The payload model's architecture ([model] TOML section).
@@ -373,6 +387,15 @@ class RuntimeConfig:
     # it later, bit-identically. 0 disables preemption (priority
     # ordering still applies at admission).
     serving_sched_swap_budget_mb: int = 0
+    # Request-scoped tracing for the paged backend (SERVING.md rung 18,
+    # runtime/tracing.py): "off" (default — zero recorder in the
+    # process), "on" (every request traced), or a sample rate in
+    # (0, 1] — the per-request decision is a deterministic hash of the
+    # request ID, so all spans of one request share fate. Tracing on is
+    # token-bit-identical to off; the flight recorder's tail ships in
+    # the last-failure.json post-mortem and GET /trace exports
+    # Chrome/Perfetto trace-event JSON.
+    serving_trace: str | float = "off"
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -545,6 +568,9 @@ class RuntimeConfig:
                     payload_doc.get("serving_sched_swap_budget_mb",
                                     cls.serving_sched_swap_budget_mb)
                 ),
+                serving_trace=_parse_trace(
+                    payload_doc.get("serving_trace", cls.serving_trace)
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -708,6 +734,17 @@ class RuntimeConfig:
                 "[payload] serving_sched_swap_budget_mb must be >= 0 "
                 "(0 = preemptive swap off)"
             )
+        if isinstance(self.serving_trace, str):
+            if self.serving_trace not in ("off", "on"):
+                raise RuntimeConfigError(
+                    "[payload] serving_trace must be 'off', 'on' or a "
+                    f"sample rate in (0, 1], got {self.serving_trace!r}"
+                )
+        elif not 0.0 < self.serving_trace <= 1.0:
+            raise RuntimeConfigError(
+                "[payload] serving_trace sample rate must be in "
+                f"(0, 1], got {self.serving_trace!r}"
+            )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
                 "[payload] kind = 'train' requires corpus = '<path>' "
@@ -800,6 +837,8 @@ class RuntimeConfig:
             f"{self.serving_sched_max_queue_wait_s}\n"
             "serving_sched_swap_budget_mb = "
             f"{self.serving_sched_swap_budget_mb}\n"
+            "serving_trace = "
+            f"{s(self.serving_trace) if isinstance(self.serving_trace, str) else self.serving_trace}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

@@ -158,7 +158,8 @@ class AdmissionScheduler:
                  classes: tuple = DEFAULT_CLASSES,
                  max_queue_depth: int = 0,
                  max_queue_wait_s: float = 0.0,
-                 swap_budget_mb: int = 0):
+                 swap_budget_mb: int = 0,
+                 tracer=None):
         if policy not in ("fifo", "strict", "weighted"):
             raise ValueError(
                 f"scheduler policy must be 'fifo', 'strict' or "
@@ -212,6 +213,11 @@ class AdmissionScheduler:
         # excludes it — the two consumers must measure the same thing.
         self._hist_swap = {c: _Hist(_WAIT_EDGES_MS)
                            for c in self.classes}
+        # Request-scoped tracing (SERVING.md rung 18): an optional
+        # runtime/tracing.py Tracer shared with the serving layer. All
+        # emissions here run under the server lock and are one ring
+        # append each — lock-cheap by the tracer's contract.
+        self.tracer = tracer
         # Host bytes currently held by swap snapshots.
         self.swap_bytes = 0
         # Counters (cumulative; survive revive()).
@@ -330,8 +336,8 @@ class AdmissionScheduler:
         return sum(self.depth_locked(c) for c in self.classes
                    if self._rank[c] <= r)
 
-    def shed_check_locked(self, pclass: str,
-                          deadline_ms: int | None) -> dict | None:
+    def shed_check_locked(self, pclass: str, deadline_ms: int | None,
+                          rid: str = "") -> dict | None:
         """Reject-early decision BEFORE enqueue. Returns None (admit to
         the queue) or ``{"reason", "retry_after_s"}`` — the serving
         layer turns the latter into a typed refusal carrying the
@@ -340,12 +346,11 @@ class AdmissionScheduler:
         est = self.wait_estimate_locked(pclass)
         depth = self.shed_depth_locked(pclass)
         if self.max_queue_depth and depth >= self.max_queue_depth:
-            self.shed += 1
-            return {"reason": f"admission queue is full "
-                              f"({depth} tickets ahead of class "
-                              f"{pclass!r} >= watermark "
-                              f"{self.max_queue_depth})",
-                    "retry_after_s": est}
+            return self._note_shed(pclass, rid, est,
+                                   f"admission queue is full "
+                                   f"({depth} tickets ahead of class "
+                                   f"{pclass!r} >= watermark "
+                                   f"{self.max_queue_depth})")
         # Wait-based sheds only apply while same-class work is parked:
         # with an empty class queue the arrival becomes the class head
         # immediately, and letting it park is the only way the wait
@@ -355,19 +360,27 @@ class AdmissionScheduler:
             return None
         if self.max_queue_wait_s and est is not None \
                 and est > self.max_queue_wait_s:
-            self.shed += 1
-            return {"reason": f"measured {pclass} queue wait "
-                              f"{est:.2f}s exceeds watermark "
-                              f"{self.max_queue_wait_s:.2f}s",
-                    "retry_after_s": est}
+            return self._note_shed(pclass, rid, est,
+                                   f"measured {pclass} queue wait "
+                                   f"{est:.2f}s exceeds watermark "
+                                   f"{self.max_queue_wait_s:.2f}s")
         if deadline_ms is not None and est is not None \
                 and est > deadline_ms / 1000.0:
-            self.shed += 1
-            return {"reason": f"deadline {deadline_ms}ms is unmeetable "
-                              f"(measured {pclass} queue wait "
-                              f"{est:.2f}s)",
-                    "retry_after_s": est}
+            return self._note_shed(pclass, rid, est,
+                                   f"deadline {deadline_ms}ms is "
+                                   f"unmeetable (measured {pclass} "
+                                   f"queue wait {est:.2f}s)")
         return None
+
+    def _note_shed(self, pclass: str, rid: str, est, reason: str) -> dict:
+        self.shed += 1
+        tr = self.tracer
+        if tr is not None:
+            # Sheds always record (they are rare and diagnostic gold),
+            # carrying the rid so a refused request's trace says why.
+            tr.event("shed", "sched", rid=rid,
+                     args={"class": pclass, "reason": reason})
+        return {"reason": reason, "retry_after_s": est}
 
     # ---- ticket lifecycle ------------------------------------------------
 
@@ -381,6 +394,10 @@ class AdmissionScheduler:
                    threading.Condition(self._lock), time.monotonic())
         self._next_no += 1
         self._queues[pclass].append(e)  # fresh no == max -> tail
+        tr = self.tracer
+        if tr is not None and getattr(req, "trace", False):
+            tr.event("enqueue", "sched", rid=getattr(req, "rid", ""),
+                     args={"class": pclass, "ticket": e.no})
         return e
 
     def admit_locked(self, entry: _Entry) -> None:
@@ -398,6 +415,16 @@ class AdmissionScheduler:
             else (1 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * wait
         )
         self._last_admit[entry.pclass] = now
+        tr = self.tracer
+        if tr is not None and getattr(entry.req, "trace", False):
+            # The queue span: enqueue -> admit, anchored on the tracer
+            # clock (the wait itself was measured on time.monotonic —
+            # both clocks are monotonic, only the epoch differs).
+            t1 = tr.now()
+            tr.span("queue", "sched", t1 - wait, t1,
+                    rid=getattr(entry.req, "rid", ""),
+                    args={"class": entry.pclass, "ticket": entry.no,
+                          "wait_ms": round(wait * 1000.0, 3)})
         self.wake_head_locked()
 
     def remove_locked(self, entry: _Entry) -> None:
@@ -450,6 +477,13 @@ class AdmissionScheduler:
         bisect.insort(self._queues[pclass], e, key=lambda x: x.no)
         self.swap_bytes += nbytes
         self.preemptions += 1
+        tr = self.tracer
+        if tr is not None:
+            # Preemptions always record: they reshape every timeline on
+            # the pool, not just the victim's.
+            tr.event("swap-out", "sched", rid=getattr(req, "rid", ""),
+                     args={"class": pclass, "ticket": ticket_no,
+                           "saved_len": saved_len, "bytes": nbytes})
         return e
 
     def pop_resume_locked(self, entry: _Entry) -> None:
@@ -466,6 +500,12 @@ class AdmissionScheduler:
         self.resumes += 1
         wait = time.monotonic() - entry.enqueued_at
         self._hist_swap[entry.pclass].observe(wait * 1000.0)
+        tr = self.tracer
+        if tr is not None:
+            tr.event("swap-in", "sched",
+                     rid=getattr(entry.req, "rid", ""),
+                     args={"class": entry.pclass, "ticket": entry.no,
+                           "residency_ms": round(wait * 1000.0, 3)})
         self.wake_head_locked()
 
     def drop_swapped_locked(self, req) -> _Entry | None:

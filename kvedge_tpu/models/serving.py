@@ -135,6 +135,16 @@ class _Request:
     # speculative dispatch can never outrun the budget even though the
     # host hasn't seen its tokens yet.
     inflight: int = 0
+    # Tracing (runtime/tracing.py): the request ID minted/accepted at
+    # HTTP ingress (echoed as X-Request-Id) and the sampling decision,
+    # made ONCE at submit so all of this request's spans share fate.
+    # The stage stamps (tracer clock) feed the serve_ttft_ms and
+    # queue-vs-decode histograms; they are recorded even with tracing
+    # off (perf_counter is cheap, histograms are always-on metrics).
+    rid: str = ""
+    trace: bool = False
+    t_submit: float = 0.0
+    t_admit: float = 0.0
 
     def pick(self, logits_row, step: int) -> int:
         """Next token from a [V] logits row, greedy or sampled. Used at
@@ -210,11 +220,18 @@ class PagedGenerationServer:
                  sched_weights: dict | None = None,
                  sched_max_queue_depth: int = 0,
                  sched_max_queue_wait_s: float = 0.0,
-                 sched_swap_budget_mb: int = 0):
+                 sched_swap_budget_mb: int = 0,
+                 tracer=None):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
         self._cfg = cfg
+        # Request-scoped tracing (runtime/tracing.py, SERVING.md rung
+        # 18): a shared flight recorder, or None (off — every emission
+        # site guards on it). Held as a plain attribute with no device
+        # or thread state, so it survives revive() and slice
+        # reformation unchanged.
+        self.tracer = tracer
         # Device-window cap (steps per dispatched greedy decode scan).
         # The per-dispatch host round trip is the paged path's tax, and
         # the relay RTT has been measured anywhere from ~1.5 ms to
@@ -258,6 +275,16 @@ class PagedGenerationServer:
         self._hist_host = _Hist((0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0,
                                  20.0, 50.0, 100.0))
         self._hist_depth = _Hist((0.0, 1.0))
+        # Per-request stage histograms (ms; always on — cheap
+        # perf_counter stamps, independent of the tracer): time to
+        # first token (submit -> prefill logits picked), the
+        # queue-vs-decode split (submit -> admit, admit -> done).
+        _stage_edges = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
+                        200.0, 500.0, 1000.0, 2000.0, 5000.0,
+                        10000.0, 30000.0)
+        self._hist_ttft = _Hist(_stage_edges)
+        self._hist_queue = _Hist(_stage_edges)
+        self._hist_decode = _Hist(_stage_edges)
         # Speculative mode (draft length K, 0 = off): greedy slots
         # advance by batched verify passes — K prompt-lookup drafts per
         # slot, one (1+K)-query forward for the whole batch, up to K+1
@@ -334,6 +361,12 @@ class PagedGenerationServer:
         # the free list empty — otherwise one tenant's growth would
         # poison the whole server (see _relieve_pool_pressure).
         self._cache.pressure_relief = self._relieve_pool_pressure
+        if tracer is not None:
+            # Share the recorder with the cache: a slice-aware cache
+            # (runtime/sliceserve.py) stamps per-op broadcast spans so
+            # a slow follower is attributable; single-host caches
+            # simply ignore the attribute.
+            self._cache.tracer = tracer
         self._pages_total = pages
         self._reserved = 0  # worst-case pages of every in-flight request
         self._lock = threading.Lock()
@@ -351,6 +384,7 @@ class PagedGenerationServer:
             max_queue_depth=sched_max_queue_depth,
             max_queue_wait_s=sched_max_queue_wait_s,
             swap_budget_mb=sched_swap_budget_mb,
+            tracer=tracer,
         )
         # Host bytes one swapped-out page costs (k + v + int8 scale
         # slabs) — victim-sized budget checks BEFORE paying the device
@@ -399,7 +433,8 @@ class PagedGenerationServer:
     def submit(self, prompt: list[int], n_new: int,
                timeout: float = 120.0, sampling: tuple | None = None,
                priority: str = "interactive",
-               deadline_ms: int | None = None) -> list[int]:
+               deadline_ms: int | None = None,
+               request_id: str = "") -> list[int]:
         """Blocking generate: returns ``prompt + n_new`` tokens.
 
         Greedy unless ``sampling = (seed_key, temperature, top_p)`` —
@@ -418,7 +453,8 @@ class PagedGenerationServer:
         """
         req = self._start(prompt, n_new, timeout, sampling,
                           stream=False, priority=priority,
-                          deadline_ms=deadline_ms)
+                          deadline_ms=deadline_ms,
+                          request_id=request_id)
         req.done.wait()
         if req.error is not None:
             raise req.error
@@ -428,7 +464,8 @@ class PagedGenerationServer:
                       timeout: float = 120.0,
                       sampling: tuple | None = None,
                       priority: str = "interactive",
-                      deadline_ms: int | None = None) -> "StreamHandle":
+                      deadline_ms: int | None = None,
+                      request_id: str = "") -> "StreamHandle":
         """Streaming generate: an iterator yielding each generated token
         as it lands, with a ``cancel()`` method.
 
@@ -443,7 +480,8 @@ class PagedGenerationServer:
         """
         req = self._start(prompt, n_new, timeout, sampling,
                           stream=True, priority=priority,
-                          deadline_ms=deadline_ms)
+                          deadline_ms=deadline_ms,
+                          request_id=request_id)
         return StreamHandle(self, req)
 
     def cancel(self, req: _Request) -> None:
@@ -516,7 +554,8 @@ class PagedGenerationServer:
     def _start(self, prompt: list[int], n_new: int, timeout: float,
                sampling: tuple | None, stream: bool,
                priority: str = "interactive",
-               deadline_ms: int | None = None) -> _Request:
+               deadline_ms: int | None = None,
+               request_id: str = "") -> _Request:
         if not prompt or n_new < 1:
             raise ValueError("need a non-empty prompt and n_new >= 1")
         self._sched.rank(priority)  # unknown classes fail fast
@@ -544,12 +583,19 @@ class PagedGenerationServer:
 
         import jax.numpy as jnp
 
+        tr = self.tracer
         req = _Request(
             prompt=list(prompt), n_new=n_new, sampling=sampling,
             pages_reserved=pages_needed,
             key_data=_raw_key_data(sampling[0]) if sampling else None,
             stream=queue.SimpleQueue() if stream else None,
             pclass=priority,
+            rid=request_id,
+            # The per-request sampling decision, made ONCE here: all of
+            # this request's spans share fate, and a caller-replayed
+            # X-Request-Id traces (or not) identically everywhere.
+            trace=tr is not None and tr.sampled(request_id),
+            t_submit=time.perf_counter(),
         )
         deadline = time.monotonic() + timeout
         if deadline_ms is not None:
@@ -562,7 +608,8 @@ class PagedGenerationServer:
             # watermarks say the wait is hopeless, with the measured
             # per-class wait as the retry hint (falling back to the
             # recovery machinery's hint).
-            shed = self._sched.shed_check_locked(priority, deadline_ms)
+            shed = self._sched.shed_check_locked(priority, deadline_ms,
+                                                 rid=request_id)
             if shed is not None:
                 hint = shed["retry_after_s"]
                 if hint is None:
@@ -624,6 +671,10 @@ class PagedGenerationServer:
                 if ticket is not None:
                     self._sched.remove_locked(ticket)
             req.admit_seq = self._sched.next_admit_seq_locked()
+            req.t_admit = time.perf_counter()
+            self._hist_queue.observe(
+                (req.t_admit - req.t_submit) * 1e3
+            )
             slot = self._free_slots.pop()
             self._reserved += pages_needed
             # Prefix sharing: start the table on the cached prefix's
@@ -653,6 +704,7 @@ class PagedGenerationServer:
         # mutations must serialize against the step loop.
         chunk = self._prefill_chunk or len(req.prompt)
         activated = False
+        t_prefill = time.perf_counter()
         try:
             logits = None
             off = shared_tokens  # cached prefix K/V are already in place
@@ -677,6 +729,20 @@ class PagedGenerationServer:
                 if self._closed:
                     raise self._refusal()
                 req.next_token = req.pick(logits, 0)
+                t_first = time.perf_counter()
+                # Time to first token: submit -> the prefill logits'
+                # pick. This is the serving-visible TTFT (the first
+                # emission rides the next loop iteration, but the
+                # token is decided here).
+                self._hist_ttft.observe((t_first - req.t_submit) * 1e3)
+                if req.trace:
+                    self.tracer.span(
+                        "prefill", "serve", t_prefill, t_first,
+                        rid=req.rid,
+                        args={"prompt": len(req.prompt),
+                              "shared": shared_tokens,
+                              "class": req.pclass},
+                    )
                 self._active[slot] = req
                 self._prefilling -= 1
                 activated = True
@@ -712,6 +778,14 @@ class PagedGenerationServer:
         if self._poison is None:
             self._poison = failure
             self._degraded_reason = f"{type(failure).__name__}: {failure}"
+        if self.tracer is not None:
+            # The poison instant anchors the flight-recorder tail the
+            # post-mortem (last-failure.json) embeds.
+            self.tracer.event(
+                "poison", "failure",
+                args={"type": type(failure).__name__,
+                      "failed": len(self._active)},
+            )
         for req in self._active.values():
             req.error = failure
             if req.stream is not None:
@@ -1306,6 +1380,11 @@ class PagedGenerationServer:
                 target=self._loop, name="kvedge-paged-serve", daemon=True
             )
             self._thread.start()
+            if self.tracer is not None:
+                # Same recorder, same timeline: the revival lands next
+                # to the poison it heals, and the tracer itself needs
+                # no reset (it holds no device or thread state).
+                self.tracer.event("revive", "serve")
             self._work.notify_all()
 
     def stats(self) -> dict:
@@ -1332,7 +1411,14 @@ class PagedGenerationServer:
                 "window_dispatch_harvest_ms": self._hist_rtt.snapshot(),
                 "window_host_ms": self._hist_host.snapshot(),
                 "window_inflight_depth": self._hist_depth.snapshot(),
+                # Per-request stage histograms (SERVING.md rung 18):
+                # TTFT and the queue-vs-decode split.
+                "ttft_ms": self._hist_ttft.snapshot(),
+                "queue_ms": self._hist_queue.snapshot(),
+                "decode_ms": self._hist_decode.snapshot(),
             }
+            if self.tracer is not None:
+                out.update(self.tracer.stats())
             # Scheduler observability: per-class queue depth and wait
             # histograms, preemption/resume/shed counters, swap gauges.
             out.update(self._sched.stats_locked())
@@ -1369,6 +1455,26 @@ class PagedGenerationServer:
         # to the decode loop (which may now resume a swapped request).
         self._sched.wake_head_locked()
         self._work.notify_all()
+
+    def _finish_request_locked(self, slot: int, req: _Request) -> None:
+        """Complete a finished request (lock held): decode-stage
+        histogram, completion span, slot/reservation release, waiter
+        wakeup — the ONE exit path every normal finish site (budget
+        sweep, inline overlap finish, speculative pass) shares."""
+        t1 = time.perf_counter()
+        if req.t_admit:
+            self._hist_decode.observe((t1 - req.t_admit) * 1e3)
+        if req.trace:
+            self.tracer.span(
+                "decode", "serve", req.t_admit or t1, t1, rid=req.rid,
+                args={"tokens": len(req.generated),
+                      "class": req.pclass},
+            )
+        del self._active[slot]
+        self._release_locked(slot, self._pages_for(req))
+        if req.stream is not None:
+            req.stream.put(_STREAM_DONE)
+        req.done.set()
 
     def _pages_needed(self, total: int, slack: bool) -> int:
         """Worst-case pages for a ``total``-token request. ``slack``
@@ -1449,11 +1555,7 @@ class PagedGenerationServer:
             self._spec_emitted += min(len(seq), room)
             self._spec_slot_passes += 1
             if len(req.generated) >= req.n_new:
-                del self._active[slot]
-                self._release_locked(slot, self._pages_for(req))
-                if req.stream is not None:
-                    req.stream.put(_STREAM_DONE)
-                req.done.set()
+                self._finish_request_locked(slot, req)
             else:
                 # room > len(seq) here: room <= len(seq) means the
                 # request just filled its budget and took the finished
@@ -1601,11 +1703,7 @@ class PagedGenerationServer:
             req = self._active[slot]
             if len(req.generated) + 1 >= req.n_new:
                 self._emit(req, req.next_token)
-                del self._active[slot]
-                self._release_locked(slot, self._pages_for(req))
-                if req.stream is not None:
-                    req.stream.put(_STREAM_DONE)
-                req.done.set()
+                self._finish_request_locked(slot, req)
 
     # ---- scheduler boundary hooks (SERVING.md rung 17) -------------------
 
@@ -1836,6 +1934,7 @@ class PagedGenerationServer:
                         for slot, req in self._active.items()
                         if req.sampling is not None
                     }
+                    t0 = time.perf_counter()
                     if not samplers:
                         produced = np.asarray(self._cache.step_window(
                             self._params, jnp.asarray(tokens), window,
@@ -1845,16 +1944,31 @@ class PagedGenerationServer:
                         produced = np.asarray(self._sampled_window(
                             tokens, window, mask, samplers
                         ))
+                    if self.tracer is not None:
+                        # Fabric span (ungated): every window stamps,
+                        # sampled request spans hang from them.
+                        self.tracer.span(
+                            "window", "serve", t0,
+                            args={"w": window,
+                                  "rows": len(self._active),
+                                  "depth": 0},
+                        )
                     for slot, req in self._active.items():
                         self._emit(req, req.next_token)
                         for i in range(window - 1):
                             self._emit(req, int(produced[i, slot]))
                         req.next_token = int(produced[window - 1, slot])
                     return "ran"
+                t0 = time.perf_counter()
                 logits = self._cache.step(
                     self._params, jnp.asarray(tokens), active=mask
                 )
                 next_tokens = self._next_tokens(logits)
+                if self.tracer is not None:
+                    self.tracer.span(
+                        "step", "serve", t0,
+                        args={"rows": len(self._active)},
+                    )
                 for slot, req in self._active.items():
                     self._emit(req, req.next_token)
                     req.next_token = next_tokens[slot]
@@ -1957,6 +2071,11 @@ class PagedGenerationServer:
                         self._inflight = self._dispatch_window_locked(
                             first=False
                         )
+                    elif self.tracer is not None:
+                        # Overlap boundary: the pipeline collapses so a
+                        # cancel/newcomer/swap can join reconciled.
+                        self.tracer.event("boundary", "serve",
+                                          args={"reason": "reconcile"})
                     self._harvest_locked(prev)
                 except Exception:
                     # prev was not reconciled — restore its inflight
@@ -2077,6 +2196,7 @@ class PagedGenerationServer:
             req.inflight += adv
         self._hist_depth.observe(0.0 if first else 1.0)
         return {"window": w, "parts": recs, "handle": handle,
+                "depth": 0 if first else 1,
                 "t0": time.perf_counter()}
 
     def _harvest_locked(self, rec: dict) -> None:
@@ -2086,9 +2206,17 @@ class PagedGenerationServer:
         cap (``adv``) — rows past their cap were frozen on device and
         their produced entries merely repeat the last live token."""
         produced = np.asarray(self._cache.harvest_window(rec["handle"]))
-        self._hist_rtt.observe(
-            (time.perf_counter() - rec["t0"]) * 1e3
-        )
+        t_harvest = time.perf_counter()
+        self._hist_rtt.observe((t_harvest - rec["t0"]) * 1e3)
+        if self.tracer is not None:
+            # Dispatch -> harvest span with the pipeline depth the
+            # window was dispatched at (0 = boundary, 1 = overlapped).
+            self.tracer.span(
+                "window", "serve", rec["t0"], t_harvest,
+                args={"w": rec["window"],
+                      "rows": len(rec["parts"]),
+                      "depth": rec.get("depth", 0)},
+            )
         t_host = time.perf_counter()
         rec["counted"] = True
         for _, req, adv in rec["parts"]:
@@ -2111,11 +2239,7 @@ class PagedGenerationServer:
                 # serial cancel-beats-finish order — the cancel sweep
                 # at the forced boundary takes it.
                 self._emit(req, req.next_token)
-                del self._active[slot]
-                self._release_locked(slot, self._pages_for(req))
-                if req.stream is not None:
-                    req.stream.put(_STREAM_DONE)
-                req.done.set()
+                self._finish_request_locked(slot, req)
         self._overlap_windows += 1
         self._hist_host.observe((time.perf_counter() - t_host) * 1e3)
 

@@ -232,6 +232,18 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
             )
         return handle.serve_fn(doc)
 
+    def trace_doc() -> dict | None:
+        # GET /trace: the serving flight recorder as Chrome trace-event
+        # JSON. Read at request time — None (404) until the serve
+        # payload is live AND [payload] serving_trace is enabled.
+        tracer = getattr(handle.serve_fn, "tracer", None)
+        return tracer.export_chrome() if tracer is not None else None
+
+    def profile_traces() -> list:
+        # GET /profile/traces: on-disk profiler captures under
+        # <state_dir>/traces/ (newest last; TraceCapture.list).
+        return trace_capture.list()
+
     def serve_degraded() -> str | None:
         # Lock-free by contract (workload.py attaches a plain attribute
         # read): /healthz is hit by liveness probes every few seconds
@@ -274,6 +286,8 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
         token=cfg.status_token,
         generator=generate,
         health_detail=health_detail,
+        trace_doc=trace_doc,
+        profile_traces=profile_traces,
     )
     handle = RuntimeHandle(
         cfg=cfg, check=_booting(), writer=writer, server=server,

@@ -171,6 +171,11 @@ class DeadlineRunner:
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
         self.dead: str | None = None  # label of the op that wedged
+        # Optional flight recorder (runtime/tracing.py), shared by the
+        # serving layer: a timeout lands as an instant in the same
+        # timeline the post-mortem embeds, so the op that killed the
+        # stream is visible next to the spans it stranded.
+        self.tracer = None
 
     @property
     def steady_s(self) -> float:
@@ -221,6 +226,12 @@ class DeadlineRunner:
         self._queue.put((fn, box, done))
         if not done.wait(timeout=budget_s):
             self.dead = str(key)
+            if self.tracer is not None:
+                self.tracer.event(
+                    "op-timeout", "failure",
+                    args={"op": str(key), "budget_s": budget_s,
+                          "compiling": first},
+                )
             raise self._refusal(
                 f"device op {key} exceeded its "
                 f"{'compile' if first else 'steady'} budget of "

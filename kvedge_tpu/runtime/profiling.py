@@ -103,6 +103,35 @@ class TraceCapture:
             shutil.rmtree(os.path.join(self._traces_dir, name),
                           ignore_errors=True)
 
+    def list(self) -> list[dict]:
+        """The on-disk captures, oldest first — ``GET /profile/traces``.
+
+        Pure filesystem walk (no profiler, no lock): safe to call from
+        the status server's handler threads at any time, including
+        while a capture is running (the in-progress dir just shows its
+        bytes-so-far).
+        """
+        out = []
+        for name in self._existing_traces():
+            trace_dir = os.path.join(self._traces_dir, name)
+            files = [
+                os.path.join(root, f)
+                for root, _, fs in os.walk(trace_dir) for f in fs
+            ]
+            try:
+                size = sum(os.path.getsize(f) for f in files)
+                mtime = os.path.getmtime(trace_dir)
+            except OSError:
+                continue  # swept by retention mid-walk
+            out.append({
+                "name": name,
+                "seq": self._trace_seq(name),
+                "age_s": round(max(0.0, time.time() - mtime), 3),
+                "files": len(files),
+                "bytes": size,
+            })
+        return out
+
     def capture(self, seconds: float = 3.0) -> dict:
         """Trace device activity for ``seconds``; return a summary doc."""
         seconds = min(max(float(seconds), 0.1), self._max_seconds)

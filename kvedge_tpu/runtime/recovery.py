@@ -257,9 +257,19 @@ class RecoverySupervisor:
         return (name == "serve-recovery"
                 and event.get("outcome") in ("failed", "escalated"))
 
+    def _trace_event(self, name: str, detail: str = "") -> None:
+        """Land a recovery instant in the server's flight recorder
+        (runtime/tracing.py) so heal attempts and outcomes sit in the
+        same timeline as the failure that started them."""
+        tr = getattr(self.server, "tracer", None)
+        if tr is not None:
+            tr.event(name, "recovery",
+                     args={"detail": detail[:160]} if detail else None)
+
     def _record(self, outcome: str, detail: str = "") -> None:
         """Append one recovery event to init-events.jsonl (best-effort;
         the breaker's cross-generation memory)."""
+        self._trace_event(f"recovery-{outcome}", detail)
         if not self.state_dir:
             return
         doc = {"event": "serve-recovery", "outcome": outcome}
@@ -293,6 +303,7 @@ class RecoverySupervisor:
                 return
             self.state = RECOVERING
             self._recovering_since = time.monotonic()
+            self._trace_event("recovery-start", str(reason))
             self._worker = threading.Thread(
                 target=self._recover, args=(reason,),
                 name="kvedge-recover", daemon=True,

@@ -87,6 +87,15 @@ from kvedge_tpu.models.kvcache import (
  OP_WSAMPLE, OP_WINDOWP, OP_WSAMPLEP, OP_SWAPOUT, OP_SWAPIN) = range(11)
 _HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
 
+# Human names for follower-side replay spans (runtime/tracing.py).
+_OP_NAMES = {
+    OP_STOP: "stop", OP_SYNC: "sync", OP_PREFILL: "prefill",
+    OP_STEP: "step", OP_WINDOW: "window", OP_SPEC: "spec",
+    OP_WSAMPLE: "wsample", OP_WINDOWP: "windowp",
+    OP_WSAMPLEP: "wsamplep", OP_SWAPOUT: "swapout",
+    OP_SWAPIN: "swapin",
+}
+
 
 def _slice_kernels(mesh, cfg, quantized: bool = False):
     """The paged kernels re-jitted with pinned output shardings: the
@@ -297,6 +306,29 @@ class SlicePagedKVCache(PagedKVCache):
 
     # ---- leader-side device seams (base-class host logic unchanged) -----
 
+    def _traced_run(self, key: tuple, op, budget_s: float | None = None):
+        """One leader-side op through the deadline runner, stamped as a
+        per-op broadcast span (cat "slice") when the serving layer
+        shared a tracer (``cache.tracer``, runtime/tracing.py). The
+        span covers header send + payload broadcast + the collective's
+        execution — the seam where a slow or lost follower shows up, so
+        a stalled slice is attributable to the op that stalled it. Off
+        (no tracer) this is exactly ``self._ops.run``."""
+        tr = getattr(self, "tracer", None)
+        if tr is None:
+            return self._ops.run(key, op, budget_s=budget_s)
+        if self._ops.tracer is None:
+            # Lazy share (also re-shares after reform() swaps in a
+            # fresh runner): a timeout's "op-timeout" instant lands in
+            # the same timeline as the op spans it interrupts.
+            self._ops.tracer = tr
+        t0 = tr.now()
+        try:
+            return self._ops.run(key, op, budget_s=budget_s)
+        finally:
+            tr.span(str(key[0]), "slice", t0,
+                    args={"op": "/".join(str(k) for k in key)})
+
     def _sync(self) -> None:
         if self._stopped or self._ops.dead is not None:
             # Teardown tail: a request thread unwinding after a hard
@@ -312,7 +344,7 @@ class SlicePagedKVCache(PagedKVCache):
             self._send_header(OP_SYNC)
             return self._bcast((tables, lengths))
 
-        tables, lengths = self._ops.run(("sync",), op)
+        tables, lengths = self._traced_run(("sync",), op)
         self._apply_sync(np.asarray(tables), np.asarray(lengths))
 
     def _apply_sync(self, tables: np.ndarray, lengths: np.ndarray):
@@ -345,7 +377,7 @@ class SlicePagedKVCache(PagedKVCache):
             sent = np.asarray(self._bcast(tokens))
             return self._exec_prefill(params, sent, slot, offset)
 
-        return self._ops.run(("prefill", tokens.shape[0]), op)
+        return self._traced_run(("prefill", tokens.shape[0]), op)
 
     def _exec_prefill(self, params, tokens: np.ndarray, slot: int,
                       offset: int):
@@ -374,7 +406,7 @@ class SlicePagedKVCache(PagedKVCache):
             return self._exec_step(params, np.asarray(sent),
                                    np.asarray(m))
 
-        return self._ops.run(("step",), op)
+        return self._traced_run(("step",), op)
 
     def _exec_step(self, params, tokens: np.ndarray, mask: np.ndarray):
         logits, self.state = self._k_step(
@@ -394,7 +426,7 @@ class SlicePagedKVCache(PagedKVCache):
             return self._exec_window(params, np.asarray(sent),
                                      np.asarray(m), n_steps)
 
-        return self._ops.run(("window", n_steps), op)
+        return self._traced_run(("window", n_steps), op)
 
     def _exec_window(self, params, tokens: np.ndarray, mask: np.ndarray,
                      n_steps: int):
@@ -426,7 +458,7 @@ class SlicePagedKVCache(PagedKVCache):
                 n_steps=n_steps,
             )
 
-        return self._ops.run(("wsample", n_steps), op)
+        return self._traced_run(("wsample", n_steps), op)
 
     def _exec_window_sampled(self, params, tokens, mask, key_data,
                              base_steps, temps, top_ps, smask, *,
@@ -468,7 +500,7 @@ class SlicePagedKVCache(PagedKVCache):
                 np.asarray(sl), n_steps=n_steps, carry=bool(carry),
             )
 
-        return self._ops.run(("windowp", n_steps), op)
+        return self._traced_run(("windowp", n_steps), op)
 
     def _exec_window_pipelined(self, params, tokens: np.ndarray,
                                mask: np.ndarray, caps: np.ndarray, *,
@@ -511,7 +543,7 @@ class SlicePagedKVCache(PagedKVCache):
                 n_steps=n_steps, carry=bool(carry),
             )
 
-        return self._ops.run(("wsamplep", n_steps), op)
+        return self._traced_run(("wsamplep", n_steps), op)
 
     def _exec_window_sampled_pipelined(self, params, tokens, mask,
                                        key_data, base_steps, temps,
@@ -543,7 +575,7 @@ class SlicePagedKVCache(PagedKVCache):
         programs were compiled at dispatch, and the steady budget is
         sized for device execution, not compilation."""
         self._check_live()
-        return self._ops.run(("wharvest",), lambda: self._read(handle))
+        return self._traced_run(("wharvest",), lambda: self._read(handle))
 
     # ---- preemptive swap (scheduler, SERVING.md rung 17) -----------------
 
@@ -561,7 +593,7 @@ class SlicePagedKVCache(PagedKVCache):
             sent = np.asarray(self._bcast(ids_np))
             return self._exec_swapout(sent)
 
-        return self._ops.run(("swapout", ids_np.shape[0]), op)
+        return self._traced_run(("swapout", ids_np.shape[0]), op)
 
     def _exec_swapout(self, ids: np.ndarray):
         out = self._k_swapout(
@@ -584,7 +616,7 @@ class SlicePagedKVCache(PagedKVCache):
                        for x in self._bcast((ids_np,) + arrs)]
             self._exec_swapin(payload[0], tuple(payload[1:]))
 
-        self._ops.run(("swapin", ids_np.shape[0]), op)
+        self._traced_run(("swapin", ids_np.shape[0]), op)
 
     def _exec_swapin(self, ids: np.ndarray, arrays: tuple) -> None:
         self.state = self._k_swapin(
@@ -619,7 +651,7 @@ class SlicePagedKVCache(PagedKVCache):
             return self._exec_spec(params, np.asarray(sent),
                                    np.asarray(m), np.asarray(smask))
 
-        return self._ops.run(("spec", tokens.shape[1]), op)
+        return self._traced_run(("spec", tokens.shape[1]), op)
 
     def _exec_spec(self, params, tokens: np.ndarray, mask: np.ndarray,
                    spec_mask: np.ndarray):
@@ -654,7 +686,7 @@ class SlicePagedKVCache(PagedKVCache):
         try:
             # STOP is a bare header — no compilation — so it gets the
             # steady budget even as a first use.
-            self._ops.run(("stop",), lambda: self._send_header(OP_STOP),
+            self._traced_run(("stop",), lambda: self._send_header(OP_STOP),
                           budget_s=self._ops.steady_s)
         except DeviceOpTimeout:
             pass
@@ -726,6 +758,13 @@ class SlicePagedKVCache(PagedKVCache):
         op, a, b, c = (int(v) for v in hdr)
         if op == OP_STOP:
             return False
+        # Per-follower replay span (cat "slice-follower"): stamped from
+        # AFTER the header lands (the header wait is leader idle time,
+        # not this follower's work) through payload receive + replay, so
+        # each host's own contribution to a slow collective is visible
+        # in its own timeline.
+        tr = getattr(self, "tracer", None)
+        t0 = tr.now() if tr is not None else 0.0
         if op == OP_SYNC:
             tables, lengths = self._bcast((
                 np.zeros((self.slots, self.max_pages_per_seq), np.int32),
@@ -814,6 +853,9 @@ class SlicePagedKVCache(PagedKVCache):
             self._exec_swapin(payload[0], tuple(payload[1:]))
         else:  # pragma: no cover - protocol corruption is slice-fatal
             raise PagedCacheError(f"unknown slice-serve op {op}")
+        if tr is not None:
+            tr.span(_OP_NAMES.get(op, str(op)), "slice-follower", t0,
+                    args={"op": op})
         return True
 
 

@@ -73,8 +73,11 @@ _SERVE_METRIC_FIELDS = (
      "tokens generated for clients"),
     ("last_latency_ms", "serve_last_latency_ms", "gauge",
      "latency of the most recently completed request"),
-    ("latency_ms_sum", "serve_latency_ms_sum", "counter",
-     "summed latency of completed requests (divide by "
+    # _total, not _sum: Prometheus counters end in _total, and a bare
+    # _sum suffix collides with the histogram exposition grammar (the
+    # /metrics conformance test pins both rules).
+    ("latency_ms_sum", "serve_latency_ms_total", "counter",
+     "summed latency of completed requests in ms (divide by "
      "kvedge_serve_completed_total for the mean)"),
     # Paged backend only: live pool occupancy.
     ("in_flight", "serve_in_flight", "gauge",
@@ -165,6 +168,21 @@ _SERVE_METRIC_FIELDS = (
      "requests rejected early by the overload watermarks "
      "(serving_sched_max_queue_depth / _wait_s) with a measured "
      "retry-after hint (paged backend)"),
+    # Request-scoped tracing (runtime/tracing.py, [payload]
+    # serving_trace): flight-recorder occupancy and loss. Present only
+    # while tracing is enabled.
+    ("trace_events", "serve_trace_events", "gauge",
+     "trace events currently held in the flight-recorder ring "
+     "(paged backend, serving_trace)"),
+    ("trace_events_total", "serve_trace_events_total", "counter",
+     "trace events recorded since boot (paged backend, "
+     "serving_trace)"),
+    ("trace_dropped_total", "serve_trace_dropped_total", "counter",
+     "trace events that fell off the bounded flight-recorder ring "
+     "(paged backend, serving_trace)"),
+    ("trace_sample", "serve_trace_sample", "gauge",
+     "per-request trace sampling rate in (0, 1] (paged backend, "
+     "serving_trace)"),
 )
 
 # Latency histograms from the serving path (models/scheduler.py _Hist
@@ -199,6 +217,19 @@ _SERVE_HISTOGRAM_FIELDS = (
      "serve_sched_swap_residency_ms_batch",
      "time preempted batch-class requests spent swapped out to "
      "host RAM in ms (swap-out to resume)"),
+    # Per-stage request latency split (models/serving.py, SERVING.md
+    # rung 18): submit->first-token, the queue leg, and the decode leg.
+    # Always on — fed from the same span boundaries tracing uses, but
+    # independent of the serving_trace knob.
+    ("ttft_ms", "serve_ttft_ms",
+     "time to first token in ms (submit to the first emitted token, "
+     "queue wait + prefill included)"),
+    ("queue_ms", "serve_queue_ms",
+     "admission queue wait in ms (submit to slot admission — the "
+     "queue leg of the TTFT split)"),
+    ("decode_ms", "serve_decode_ms",
+     "admission-to-completion time in ms (the prefill + decode leg "
+     "of the latency split)"),
 )
 
 
@@ -298,7 +329,9 @@ class StatusServer:
                  profiler: Callable[[float], dict] | None = None,
                  token: str = "",
                  generator: Callable[[dict], dict] | None = None,
-                 health_detail: Callable[[], dict | None] | None = None):
+                 health_detail: Callable[[], dict | None] | None = None,
+                 trace_doc: Callable[[], dict | None] | None = None,
+                 profile_traces: Callable[[], list] | None = None):
         outer = self
         self._healthy = healthy or (
             lambda: bool(snapshot().get("ok", False))
@@ -307,6 +340,13 @@ class StatusServer:
         self._profiler = profiler
         self._token = token
         self._generator = generator
+        # GET /trace: the serving flight recorder as Chrome trace-event
+        # JSON (runtime/tracing.py export_chrome). Returning None means
+        # tracing is off -> 404 with a pointer at the knob.
+        self._trace_doc = trace_doc
+        # GET /profile/traces: the on-disk profiler captures under
+        # <state_dir>/traces/ (runtime/profiling.py TraceCapture.list).
+        self._profile_traces = profile_traces
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet by default
@@ -347,6 +387,23 @@ class StatusServer:
                     self._send(200, outer._snapshot())
                 elif self.path == "/version":
                     self._send(200, {"version": __version__})
+                elif self.path == "/trace":
+                    doc = (outer._trace_doc()
+                           if outer._trace_doc is not None else None)
+                    if doc is None:
+                        self._send(404, {
+                            "error": "tracing is off — enable [payload] "
+                                     "serving_trace (on, or a sample "
+                                     "rate in (0, 1])"
+                        })
+                    else:
+                        self._send(200, doc)
+                elif urlsplit(self.path).path == "/profile/traces":
+                    if outer._profile_traces is None:
+                        self._send(503, {"error": "profiler not available"})
+                    else:
+                        self._send(200,
+                                   {"traces": outer._profile_traces()})
                 elif urlsplit(self.path).path == "/profile":
                     self._send(405, {
                         "error": "use POST /profile?seconds=N to capture"
@@ -432,6 +489,15 @@ class StatusServer:
                 except (json.JSONDecodeError, UnicodeDecodeError) as e:
                     self._send(400, {"error": f"invalid JSON body: {e}"})
                     return
+                # Caller-supplied request ID: ride it into the serving
+                # layer as the reserved "_request_id" doc key (the
+                # request parser ignores unknown keys; workload.py
+                # sanitizes and echoes it, or mints one). The response
+                # carries it both in the JSON body and as an
+                # X-Request-Id header so clients correlate either way.
+                rid_in = self.headers.get("X-Request-Id")
+                if rid_in and isinstance(doc, dict):
+                    doc.setdefault("_request_id", rid_in)
                 try:
                     result = outer._generator(doc)
                 except ValueError as e:  # malformed request semantics
@@ -444,8 +510,12 @@ class StatusServer:
                     self._send(500, {"error": f"generate failed: {e!r}"})
                     return
                 stream = (result or {}).get("_stream")
+                rid_out = (result or {}).get("request_id")
+                rid_headers = (
+                    {"X-Request-Id": str(rid_out)} if rid_out else None
+                )
                 if stream is None:
-                    self._send(200, result)
+                    self._send(200, result, extra_headers=rid_headers)
                     return
                 # Streaming: newline-delimited JSON, one document per
                 # token, end-of-body delimited by connection close
@@ -455,6 +525,8 @@ class StatusServer:
                 # {"error": ...} line.
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
+                for name, value in (rid_headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.close_connection = True
                 try:

@@ -1,0 +1,264 @@
+"""Request-scoped tracing: span timelines, a flight recorder, Perfetto export.
+
+The status surface this repo grew (/status, /metrics, POST /profile) is
+all *aggregates* — until now there were no request IDs anywhere in the
+codebase, so when an interactive request blew its p99 there was no way
+to attribute the time to queue wait vs prefill vs window dispatch vs a
+preemptive swap vs a slow slice follower. This module is the missing
+attribution layer, in the same spirit as the device-level story
+``jax.profiler`` already tells in runtime/profiling.py — but for the
+HOST side of serving: the scheduler, the decode loop, the slice op
+stream, and the failure/recovery machinery.
+
+Design constraints (SERVING.md rung 18):
+
+* **Lock-cheap.** Spans are recorded from under the server's ONE work
+  lock (SERVING.md invariant 5) and from the decode loop's hot path.
+  A record is ONE ``deque.append`` of a plain tuple — appends on a
+  bounded deque are atomic under the GIL, so the recorder takes no
+  lock of its own and never wakes anything. The uncontended-admit
+  timing contract (serving.py) is preserved: tracing adds O(1) host
+  work and zero notifies.
+* **Bounded.** The buffer is a fixed-size ring (the **flight
+  recorder**): the newest ``capacity`` events win, the oldest fall
+  off. ``dropped`` counts what fell off. On pool poison the last N
+  events are embedded in the ``last-failure.json`` post-mortem
+  (runtime/workload.py), so a crash ships its own timeline.
+* **Monotonic clocks.** Every stamp is ``time.perf_counter()`` —
+  wall-clock steps (NTP) cannot reorder a timeline. Export rebases on
+  the tracer's epoch so Chrome/Perfetto sees small positive
+  microsecond stamps.
+* **Deterministic sampling.** The ``serving_trace`` knob is
+  off / on / a sample rate in (0, 1]. The sampling decision is a pure
+  hash of the request ID, made ONCE at ingress — all spans of one
+  request share fate, and a caller-supplied ``X-Request-Id`` yields
+  the same decision on every pod. Global (non-request) spans — window
+  timing, slice ops, failure/recovery events — always record when the
+  tracer is enabled: they are the fabric the sampled request spans
+  hang from.
+* **Zero effect on tokens.** The tracer never touches device state,
+  never sleeps, never raises into the serving path; tracing on vs off
+  is token-bit-identical (pinned by tests/test_tracing.py) and the
+  tracer object survives ``revive()`` and slice reformation unchanged
+  (it holds no device or thread state).
+
+Export targets:
+
+* ``GET /trace`` (runtime/status.py) returns
+  :meth:`Tracer.export_chrome` — Chrome trace-event JSON, loadable in
+  Perfetto / ``chrome://tracing`` next to the XProf captures.
+* ``/metrics`` per-stage histograms (``serve_ttft_ms`` and the
+  queue-vs-decode split) are fed by models/serving.py from the same
+  span boundaries.
+* ``last-failure.json`` embeds :meth:`Tracer.last_events`.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+import uuid
+import zlib
+
+# Record layout (plain tuple — cheap to build under the work lock):
+#   (ph, t0, dur, name, cat, rid, args)
+# ph is "X" (complete span, dur in seconds) or "i" (instant, dur 0.0).
+# rid is "" for global events; args is a small JSON-safe dict or None.
+
+# Flight-recorder tail embedded in the last-failure.json post-mortem.
+POSTMORTEM_EVENTS = 64
+
+# Request-id hygiene: caller-supplied X-Request-Id values ride into
+# logs, JSON and trace exports; cap length and restrict the alphabet so
+# a hostile header cannot smuggle structure anywhere downstream.
+_RID_MAX_LEN = 64
+_RID_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_.:"
+)
+
+
+def new_request_id() -> str:
+    """Mint a request ID at HTTP ingress (workload.py). Random, not
+    sequential: IDs must not collide across pods behind one
+    LoadBalancer, and must not leak request volume."""
+    return "req-" + uuid.uuid4().hex[:16]
+
+
+def clean_request_id(raw) -> str:
+    """A caller-supplied request ID, sanitized; "" when unusable."""
+    if not isinstance(raw, str) or not raw:
+        return ""
+    rid = raw[:_RID_MAX_LEN]
+    if all(c in _RID_OK for c in rid):
+        return rid
+    return ""
+
+
+class Tracer:
+    """A lock-cheap, bounded span recorder (the flight recorder).
+
+    One instance per serving pool, shared by reference with the
+    scheduler, the cache (slice op stream) and the recovery machinery.
+    All methods are safe to call from any thread without additional
+    locking: the only mutation is an append on a bounded deque (atomic
+    under the GIL) and a few monotonically-increasing counters whose
+    races are benign (observability, not accounting).
+    """
+
+    def __init__(self, sample: float = 1.0, capacity: int = 4096):
+        self.sample = float(sample)
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._appended = 0
+        self.epoch = time.perf_counter()
+
+    # ---- construction from the config knob -------------------------------
+
+    @staticmethod
+    def from_knob(value, capacity: int = 4096) -> "Tracer | None":
+        """``serving_trace`` (off / on / rate in (0,1]) -> a tracer or
+        None. None is the off state: every call site guards with
+        ``if tracer is not None`` so off costs one attribute read."""
+        if value in ("off", "", None, False):
+            return None
+        if value in ("on", True):
+            return Tracer(sample=1.0, capacity=capacity)
+        rate = float(value)
+        if not (0.0 < rate <= 1.0):
+            raise ValueError(
+                f"serving_trace sample rate must be in (0, 1], got {rate!r}"
+            )
+        return Tracer(sample=rate, capacity=capacity)
+
+    # ---- recording --------------------------------------------------------
+
+    @staticmethod
+    def now() -> float:
+        return time.perf_counter()
+
+    def sampled(self, rid: str) -> bool:
+        """Deterministic per-request sampling decision: a pure hash of
+        the ID, so all spans of one request share fate and a replayed
+        ``X-Request-Id`` traces (or not) identically everywhere."""
+        if self.sample >= 1.0:
+            return True
+        bucket = zlib.crc32(rid.encode("utf-8", "replace")) % 10_000
+        return bucket < int(self.sample * 10_000)
+
+    def span(self, name: str, cat: str, t0: float, t1: float | None = None,
+             rid: str = "", args: dict | None = None) -> None:
+        """Record a complete span [t0, t1] (tracer clock)."""
+        if t1 is None:
+            t1 = time.perf_counter()
+        self._ring.append(("X", t0, max(0.0, t1 - t0), name, cat, rid, args))
+        self._appended += 1
+
+    def event(self, name: str, cat: str, rid: str = "",
+              args: dict | None = None) -> None:
+        """Record an instant event at now()."""
+        self._ring.append(
+            ("i", time.perf_counter(), 0.0, name, cat, rid, args)
+        )
+        self._appended += 1
+
+    # ---- introspection ----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def dropped(self) -> int:
+        """Events that fell off the ring (flight-recorder overwrite)."""
+        return max(0, self._appended - len(self._ring))
+
+    def stats(self) -> dict:
+        return {
+            "trace_events": len(self._ring),
+            "trace_events_total": self._appended,
+            "trace_dropped_total": self.dropped,
+            "trace_sample": self.sample,
+        }
+
+    def _snapshot(self) -> list:
+        """A consistent copy of the ring. deque iteration can raise
+        RuntimeError if a writer appends concurrently; retry a few
+        times, then settle for list() (which copies atomically enough
+        for observability purposes)."""
+        for _ in range(4):
+            try:
+                return list(self._ring)
+            except RuntimeError:
+                continue
+        return list(self._ring)
+
+    def last_events(self, n: int = POSTMORTEM_EVENTS) -> list[dict]:
+        """The newest ``n`` events as JSON-safe dicts, oldest first —
+        the post-mortem embed for ``last-failure.json``."""
+        out = []
+        for ph, t0, dur, name, cat, rid, args in self._snapshot()[-n:]:
+            doc = {
+                "name": name,
+                "cat": cat,
+                "t_ms": round((t0 - self.epoch) * 1000.0, 3),
+            }
+            if ph == "X":
+                doc["dur_ms"] = round(dur * 1000.0, 3)
+            if rid:
+                doc["rid"] = rid
+            if args:
+                doc["args"] = args
+            out.append(doc)
+        return out
+
+    # ---- Chrome/Perfetto export -------------------------------------------
+
+    def export_chrome(self) -> dict:
+        """The ring as Chrome trace-event JSON (``GET /trace``).
+
+        One process (pid 1), one track (tid) per span category, named
+        with ph="M" thread_name metadata so Perfetto labels the rows.
+        Timestamps are microseconds from the tracer's epoch (perf
+        counter — monotonic, so the timeline cannot fold)."""
+        tids: dict[str, int] = {}
+        events = []
+        for ph, t0, dur, name, cat, rid, args in self._snapshot():
+            tid = tids.get(cat)
+            if tid is None:
+                tid = tids[cat] = len(tids) + 1
+            ev = {
+                "name": name,
+                "cat": cat,
+                "ph": ph,
+                "ts": round((t0 - self.epoch) * 1e6, 1),
+                "pid": 1,
+                "tid": tid,
+            }
+            if ph == "X":
+                ev["dur"] = round(dur * 1e6, 1)
+            else:
+                ev["s"] = "t"  # instant scope: thread
+            a = dict(args) if args else {}
+            if rid:
+                a["rid"] = rid
+            if a:
+                ev["args"] = a
+            events.append(ev)
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": tid,
+                "args": {"name": cat},
+            }
+            for cat, tid in sorted(tids.items(), key=lambda kv: kv[1])
+        ]
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "recorder": "kvedge-tpu flight recorder",
+                "dropped": self.dropped,
+                "sample": self.sample,
+            },
+        }

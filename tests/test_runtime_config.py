@@ -210,6 +210,27 @@ def test_serving_overlap_knob_round_trips_and_validates():
             RuntimeConfig.parse(f"[payload]\n{bad}\n")
 
 
+def test_serving_trace_knob_round_trips_and_validates():
+    cfg = RuntimeConfig.parse(
+        "[payload]\nserving = 'paged'\nserving_trace = 'on'\n"
+    )
+    assert cfg.serving_trace == "on"
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    assert RuntimeConfig.parse("").serving_trace == "off"
+    sampled = RuntimeConfig.parse("[payload]\nserving_trace = 0.25\n")
+    assert sampled.serving_trace == 0.25
+    assert RuntimeConfig.parse(sampled.to_toml()) == sampled
+    # An integer 1 is a valid rate (TOML writers vary on 1 vs 1.0).
+    assert RuntimeConfig.parse(
+        "[payload]\nserving_trace = 1\n"
+    ).serving_trace == 1.0
+    for bad in ("serving_trace = 'sometimes'", "serving_trace = 0.0",
+                "serving_trace = 1.5", "serving_trace = -0.5",
+                "serving_trace = true"):
+        with pytest.raises(RuntimeConfigError):
+            RuntimeConfig.parse(f"[payload]\n{bad}\n")
+
+
 def test_paged_attention_knob_round_trips_and_threads():
     cfg = RuntimeConfig.parse(
         "[payload]\nserving = 'paged'\npaged_attention = 'gather'\n"

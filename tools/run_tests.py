@@ -37,6 +37,10 @@ Usage::
                                          # tests (-m sched: priority,
                                          # preemptive swap, shedding);
                                          # fast, also tier-1
+    python tools/run_tests.py --trace    # only the request-tracing
+                                         # tests (-m trace: flight
+                                         # recorder, Chrome export,
+                                         # bit-identity); fast, tier-1
     python tools/run_tests.py --list     # show the shard plan only
 
 Prints a per-shard progress line and ONE aggregate summary; exits 0
@@ -171,6 +175,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--sched", action="store_true",
                     help="run only the admission-scheduler tests "
                          "(forwards -m sched)")
+    ap.add_argument("--trace", action="store_true",
+                    help="run only the request-tracing tests "
+                         "(forwards -m trace)")
     ap.add_argument("pytest_args", nargs="*",
                     help="extra args forwarded to pytest (e.g. -k expr)")
     args, unknown = ap.parse_known_args(argv)
@@ -183,6 +190,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "overlap"]
     if args.sched:
         args.pytest_args += ["-m", "sched"]
+    if args.trace:
+        args.pytest_args += ["-m", "trace"]
 
     counts = collect_counts(args.pytest_args)
     if not counts:
