@@ -1,0 +1,7 @@
+"""Static analysis for the serving stack's concurrency contracts.
+
+:mod:`kvedge_tpu.analysis.locklint` is the lock-discipline analyzer
+(SERVING.md rung 19); ``tools/locklint.py`` is its CLI. Everything in
+this package is stdlib-only — it must import (and run in CI) without
+jax or a device.
+"""

@@ -396,6 +396,13 @@ class RuntimeConfig:
     # the last-failure.json post-mortem and GET /trace exports
     # Chrome/Perfetto trace-event JSON.
     serving_trace: str | float = "off"
+    # Lock-discipline assertions (SERVING.md rung 19): swap the
+    # serving stack's work lock for an ownership-asserting DebugLock
+    # and wrap every *_locked method to verify the calling thread
+    # holds it — the runtime twin of `tools/locklint.py`. Debug/test
+    # only: correct code behaves identically, violations raise
+    # LockDisciplineError instead of racing.
+    serving_debug_locks: bool = False
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -571,6 +578,9 @@ class RuntimeConfig:
                 serving_trace=_parse_trace(
                     payload_doc.get("serving_trace", cls.serving_trace)
                 ),
+                serving_debug_locks=payload_doc.get(
+                    "serving_debug_locks", cls.serving_debug_locks
+                ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
                 ),
@@ -745,6 +755,10 @@ class RuntimeConfig:
                 "[payload] serving_trace sample rate must be in "
                 f"(0, 1], got {self.serving_trace!r}"
             )
+        if not isinstance(self.serving_debug_locks, bool):
+            raise RuntimeConfigError(
+                "[payload] serving_debug_locks must be a boolean"
+            )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
                 "[payload] kind = 'train' requires corpus = '<path>' "
@@ -839,6 +853,8 @@ class RuntimeConfig:
             f"{self.serving_sched_swap_budget_mb}\n"
             "serving_trace = "
             f"{s(self.serving_trace) if isinstance(self.serving_trace, str) else self.serving_trace}\n"
+            "serving_debug_locks = "
+            f"{'true' if self.serving_debug_locks else 'false'}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

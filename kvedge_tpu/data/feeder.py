@@ -89,6 +89,7 @@ def _load_native():
             # image) still load it: a failed make only raises when no
             # library exists at all.
             try:
+                # locklint: allow[io-under-lock] one-time lazy init — the module lock exists precisely to serialize the native build+dlopen; waiters need the finished library anyway, and no request-path lock is held here
                 subprocess.run(
                     ["make", "-C", str(_NATIVE_DIR)],
                     check=True, capture_output=True,

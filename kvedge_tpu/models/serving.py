@@ -221,7 +221,7 @@ class PagedGenerationServer:
                  sched_max_queue_depth: int = 0,
                  sched_max_queue_wait_s: float = 0.0,
                  sched_swap_budget_mb: int = 0,
-                 tracer=None):
+                 tracer=None, debug_locks: bool = False):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
@@ -369,7 +369,16 @@ class PagedGenerationServer:
             self._cache.tracer = tracer
         self._pages_total = pages
         self._reserved = 0  # worst-case pages of every in-flight request
-        self._lock = threading.Lock()
+        # Lock discipline ([payload] serving_debug_locks, SERVING.md
+        # rung 19): the ownership-asserting DebugLock makes every
+        # *_locked call and every Condition wait/notify verify the
+        # calling thread actually holds the lock — the runtime twin of
+        # the locklint static analyzer. Plain Lock in production.
+        if debug_locks:
+            from kvedge_tpu.runtime.debuglock import DebugLock
+            self._lock = DebugLock()
+        else:
+            self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         # Admission scheduler (models/scheduler.py, SERVING.md rung 17):
         # per-class ticketed queue + preemption/shed policy. It SHARES
@@ -423,6 +432,16 @@ class PagedGenerationServer:
         # drain must not report done — while any exist, or their
         # waiters would hang on a request no loop will ever serve.
         self._prefilling = 0
+        if debug_locks:
+            # Wrap every bound *_locked method (server AND the
+            # scheduler sharing its lock) to assert ownership at call
+            # time — executed L1, before the decode thread exists so
+            # the loop only ever sees the checked bindings.
+            from kvedge_tpu.runtime.debuglock import (
+                instrument_locked_methods,
+            )
+            instrument_locked_methods(self, self._lock)
+            instrument_locked_methods(self._sched, self._lock)
         self._thread = threading.Thread(
             target=self._loop, name="kvedge-paged-serve", daemon=True
         )
@@ -1858,6 +1877,7 @@ class PagedGenerationServer:
             # observed as a waiter never getting to raise ServerBusy
             # until the occupying request finished). One zero-sleep with
             # the lock released yields the GIL so waiters can take it.
+            # locklint: allow[sleep-under-lock] deliberate GIL yield with the lock RELEASED — breaks the decode loop's lock convoy so expired admission waiters win the reacquisition race (rung 17 fair handoff; removing it starves ServerBusy)
             time.sleep(0)
 
     def _loop_once(self) -> str:

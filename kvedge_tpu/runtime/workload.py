@@ -1296,6 +1296,11 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 # cleanly from host tokens on every recovery cycle.
                 overlap=cfg.serving_overlap,
                 tracer=tracer,
+                # Lock-discipline assertions ([payload]
+                # serving_debug_locks, SERVING.md rung 19): runtime
+                # twin of tools/locklint.py — *_locked calls assert
+                # ownership, Condition ops become thread-accurate.
+                debug_locks=cfg.serving_debug_locks,
             )
             # Degraded-mode observability: when the pool poisons
             # (runtime/failures.py), persist a post-mortem failure

@@ -1,0 +1,41 @@
+"""L1 true negatives: every *_locked call is provably lock-held."""
+
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self.depth = 0
+
+    def _admit_locked(self, n):
+        self.depth += n
+
+    def submit(self, n):
+        # TN: syntactically under the lock.
+        with self._lock:
+            self._admit_locked(n)
+
+    def submit_via_cond(self, n):
+        # TN: a Condition on the same lock counts.
+        with self._work:
+            self._admit_locked(n)
+
+    def drain_locked(self):
+        # TN: caller is *_locked itself — the contract chains.
+        self._admit_locked(-self.depth)
+
+    def _helper(self, n):
+        # TN: inferred locked — every intra-class call site of _helper
+        # holds the lock and it is never taken as a bare reference.
+        self._admit_locked(n)
+
+    def batch(self, items):
+        with self._lock:
+            for n in items:
+                self._helper(n)
+
+    def late(self, n):
+        with self._work:
+            self._helper(n)

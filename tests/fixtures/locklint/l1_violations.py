@@ -1,0 +1,34 @@
+"""L1 true positives: *_locked calls without the lock, and a relock."""
+
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0
+
+    def _admit_locked(self, n):
+        self.depth += n
+
+    def submit(self, n):
+        # TP: no with-block, caller not *_locked, not inferable.
+        self._admit_locked(n)
+
+    def drain_locked(self):
+        # TP: re-acquiring the class's own non-reentrant lock while the
+        # *_locked contract says it is already held — self-deadlock.
+        with self._lock:
+            self.depth = 0
+
+    def on_timer(self):
+        self._maybe_admit(1)
+
+    def _maybe_admit(self, n):
+        # TP: _maybe_admit is referenced bare below (escapes as a
+        # callback), so it can NOT be inferred locked even though its
+        # only direct call site never holds the lock anyway.
+        self._admit_locked(n)
+
+    def register(self, bus):
+        bus.subscribe(self._maybe_admit)

@@ -1,0 +1,41 @@
+"""L2 true negatives: the same primitives OUTSIDE any lock."""
+
+import threading
+import time
+
+import jax
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.out = None
+
+    def poll(self):
+        # TN: sleeping without the lock is an ordinary poll interval.
+        time.sleep(0.25)
+
+    def sync(self, x):
+        # TN: device sync outside the lock.
+        y = jax.device_get(x)
+        with self._lock:
+            self.out = y
+
+    def persist(self, path, blob):
+        with self._lock:
+            snapshot = bytes(blob)
+        # TN: the write happens after release — the dump_prefix_cache
+        # shape (snapshot under the lock, I/O outside it).
+        with open(path, "wb") as fh:
+            fh.write(snapshot)
+
+    def wait_stop(self):
+        # TN: event wait with no lock held.
+        self._stop.wait(1.0)
+
+    def run_forever(self, sink):
+        # TN: zero-sleep in a loop that never touches a lock.
+        while not self._stop.is_set():
+            sink.append(None)
+            time.sleep(0)

@@ -1,0 +1,45 @@
+"""L2 true positives: blocking work while holding a lock."""
+
+import subprocess
+import threading
+import time
+
+import jax
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.out = None
+
+    def slow_tick(self):
+        with self._lock:
+            time.sleep(0.25)          # TP: sleep-under-lock
+
+    def sync(self, x):
+        with self._lock:
+            self.out = jax.device_get(x)       # TP: device-sync
+            x.block_until_ready()              # TP: device-sync
+
+    def persist(self, path):
+        with self._lock:
+            with open(path, "wb") as fh:       # TP: io-under-lock
+                fh.write(b"state")
+
+    def shell(self):
+        with self._lock:
+            subprocess.run(["true"])           # TP: io-under-lock
+
+    def wait_stop(self):
+        with self._lock:
+            self._stop.wait(1.0)               # TP: foreign-wait
+
+    def run_forever(self):
+        while True:
+            with self._lock:
+                self.out = None
+            time.sleep(0)             # TP: zero-sleep in a lock cycle
+
+    def handoff_locked(self):
+        time.sleep(0)                 # TP: zero-sleep, contract-held

@@ -1,0 +1,35 @@
+"""L3 true negatives: textbook condition usage."""
+
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self.items = []
+
+    def pop(self):
+        with self._work:
+            # TN: wait under a re-checked predicate.
+            while not self.items:
+                self._work.wait()
+            return self.items.pop()
+
+    def pop_timeout(self, deadline):
+        with self._work:
+            # TN: for-loop retry around a timed wait also counts.
+            for _ in range(3):
+                if self.items:
+                    break
+                self._work.wait(timeout=deadline)
+            return self.items.pop() if self.items else None
+
+    def push(self, item):
+        with self._work:
+            self.items.append(item)
+            self._work.notify_all()  # TN: notify under the lock
+
+    def kick_locked(self):
+        # TN: the *_locked contract means the lock is held here.
+        self._work.notify()

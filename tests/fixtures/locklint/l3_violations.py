@@ -1,0 +1,26 @@
+"""L3 true positives: condition-variable hygiene violations."""
+
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self.items = []
+
+    def pop_bare(self):
+        with self._work:
+            # TP: wait with no predicate loop — spurious wakeups and
+            # notify races return control with the predicate false.
+            self._work.wait()
+            return self.items.pop()
+
+    def push_unlocked(self, item):
+        self.items.append(item)
+        # TP: notify without holding the owning lock — a waiter
+        # between predicate check and wait() misses this forever.
+        self._work.notify_all()
+
+    def kick(self):
+        self._work.notify()          # TP: same, single notify

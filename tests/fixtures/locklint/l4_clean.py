@@ -1,0 +1,31 @@
+"""L4 true negatives: guarded fields written only under the lock, and
+a class with no lock discipline at all (L4 must not apply)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def reset(self):
+        with self._lock:
+            self.total = 0      # TN: same lock, both sites
+
+    def reset_locked(self):
+        self.total = 0          # TN: contract-held
+
+
+class PlainBag:
+    """No lock attr, no *_locked methods: writes are just writes."""
+
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v):
+        self.value = v          # TN: no discipline to violate

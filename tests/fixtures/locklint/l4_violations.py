@@ -1,0 +1,23 @@
+"""L4 true positives: unguarded writes to lock-guarded fields."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0          # __init__ writes never count
+        self.errors = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n     # establishes total as guarded
+
+    def note_error_locked(self):
+        self.errors += 1        # establishes errors as guarded
+
+    def reset(self):
+        # TP x2: both fields are written under the lock elsewhere,
+        # and here written with no lock at all — "it's just a flag".
+        self.total = 0
+        self.errors = 0

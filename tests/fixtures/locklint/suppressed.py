@@ -1,0 +1,31 @@
+"""Suppression-syntax fixture: audited, reasonless, and stale pragmas."""
+
+import threading
+import time
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = None
+
+    def tick_inline(self):
+        with self._lock:
+            time.sleep(0.01)  # locklint: allow[sleep-under-lock] fixture: audited same-line pragma
+
+    def tick_above(self):
+        with self._lock:
+            # locklint: allow[sleep-under-lock] fixture: pragma on the line above
+            time.sleep(0.01)
+
+    def tick_by_rule(self):
+        with self._lock:
+            time.sleep(0.01)  # locklint: allow[L2] fixture: rule-name match
+
+    def tick_reasonless(self):
+        with self._lock:
+            time.sleep(0.01)  # locklint: allow[sleep-under-lock]
+
+    def stale(self):
+        # locklint: allow[io-under-lock] fixture: nothing here blocks
+        self.state = "idle"

@@ -7,6 +7,15 @@ are part of the suite. The stress driver covers sustained consumer-vs-
 producer racing, teardown while the producer is blocked on a full ring,
 and error-path opens (leak coverage). A sanitizer finding makes the
 binary exit non-zero, failing the test with its report.
+
+Selection: ``-m san`` (tools/run_tests.py --san). Marked slow — the
+sanitized runs take minutes under TSAN's shadow machinery — so the
+tier-1 gate (-m 'not slow') skips them; the CI/native lane opts in.
+The PREBUILT harnesses at ``native/build/feed-stress-{asan,tsan}``
+(baked into the runtime image, where no sanitizer toolchain exists)
+are used when present and current; otherwise the test rebuilds via
+make, and skips only when neither a binary nor a toolchain is
+available.
 """
 
 import pathlib
@@ -19,6 +28,19 @@ import pytest
 from kvedge_tpu.data import write_corpus
 
 NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+
+pytestmark = [pytest.mark.san, pytest.mark.slow]
+
+
+def _stale(binary: pathlib.Path) -> bool:
+    """Is the prebuilt harness older than any native source? A stale
+    binary sanitizes LAST week's code — prefer a rebuild when we can."""
+    try:
+        built = binary.stat().st_mtime
+    except OSError:
+        return True
+    sources = list(NATIVE_DIR.glob("*.cc")) + list(NATIVE_DIR.glob("*.h"))
+    return any(src.stat().st_mtime > built for src in sources)
 
 
 def _build(target: str) -> pathlib.Path | None:
@@ -48,6 +70,18 @@ def _build(target: str) -> pathlib.Path | None:
     return NATIVE_DIR / "build" / f"feed-stress-{target}"
 
 
+def _harness(target: str) -> pathlib.Path | None:
+    """The sanitizer binary to run: a current prebuilt, a fresh build,
+    or — when the toolchain is absent — whatever prebuilt exists."""
+    prebuilt = NATIVE_DIR / "build" / f"feed-stress-{target}"
+    if prebuilt.exists() and not _stale(prebuilt):
+        return prebuilt
+    built = _build(target)
+    if built is not None:
+        return built
+    return prebuilt if prebuilt.exists() else None
+
+
 @pytest.fixture
 def corpus(tmp_path):
     path = tmp_path / "corpus.kvfeed"
@@ -57,9 +91,10 @@ def corpus(tmp_path):
 
 @pytest.mark.parametrize("sanitizer", ["tsan", "asan"])
 def test_feeder_clean_under_sanitizer(sanitizer, corpus):
-    binary = _build(sanitizer)
+    binary = _harness(sanitizer)
     if binary is None:
-        pytest.skip(f"cannot build {sanitizer} harness here")
+        pytest.skip(f"no prebuilt {sanitizer} harness and no toolchain "
+                    f"to build one")
     proc = subprocess.run(
         [str(binary), str(corpus), "300"],
         capture_output=True, text=True, timeout=300,

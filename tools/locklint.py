@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""CLI for the lock-discipline analyzer (SERVING.md rung 19).
+
+Usage:
+    python tools/locklint.py kvedge_tpu/            # human output
+    python tools/locklint.py --json kvedge_tpu/     # CI / machines
+    python tools/locklint.py --rules L1,L3 <paths>  # rule subset
+
+Exit status: 0 clean, 1 unsuppressed findings, 2 usage error.
+
+Stdlib-only on purpose — this must run in a bare CI container with no
+jax installed, so it imports the analyzer package directly off the
+repo checkout rather than requiring `pip install -e .`.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from kvedge_tpu.analysis.locklint import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

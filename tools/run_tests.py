@@ -41,6 +41,16 @@ Usage::
                                          # tests (-m trace: flight
                                          # recorder, Chrome export,
                                          # bit-identity); fast, tier-1
+    python tools/run_tests.py --lint     # lock-discipline gate: runs
+                                         # tools/locklint.py over the
+                                         # package (fast-fails on any
+                                         # unsuppressed finding), then
+                                         # the analyzer's tests (-m
+                                         # lint); fast, tier-1
+    python tools/run_tests.py --san      # native ASan/TSan feed-stress
+                                         # harnesses (-m san; slow,
+                                         # skipped when binaries and
+                                         # compiler are both absent)
     python tools/run_tests.py --list     # show the shard plan only
 
 Prints a per-shard progress line and ONE aggregate summary; exits 0
@@ -178,6 +188,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="run only the request-tracing tests "
                          "(forwards -m trace)")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the lock-discipline gate: tools/locklint.py "
+                         "over kvedge_tpu/, then the analyzer's own tests "
+                         "(forwards -m lint)")
+    ap.add_argument("--san", action="store_true",
+                    help="run the native ASan/TSan feed-stress harnesses "
+                         "(forwards -m san; slow-marked, auto-skipped "
+                         "when neither prebuilt binaries nor a compiler "
+                         "exist)")
     ap.add_argument("pytest_args", nargs="*",
                     help="extra args forwarded to pytest (e.g. -k expr)")
     args, unknown = ap.parse_known_args(argv)
@@ -192,6 +211,21 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "sched"]
     if args.trace:
         args.pytest_args += ["-m", "trace"]
+    if args.lint:
+        # The analyzer gate runs FIRST and fast-fails: a tree with
+        # unsuppressed findings should not spend minutes in pytest
+        # before saying so. Its own test file then re-checks the same
+        # invariant (plus fixtures) under -m lint.
+        gate = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "locklint.py"),
+             str(REPO / "kvedge_tpu")],
+            cwd=REPO,
+        )
+        if gate.returncode != 0:
+            return gate.returncode
+        args.pytest_args += ["-m", "lint"]
+    if args.san:
+        args.pytest_args += ["-m", "san"]
 
     counts = collect_counts(args.pytest_args)
     if not counts:
