@@ -347,6 +347,18 @@ class RuntimeConfig:
     # an explicit K keeps the operator's choice but logs a loud
     # warning under the same test (single-host serve only).
     serving_speculative: int | str = 0
+    # Device-resident speculative windows (SERVING.md rung 20): W > 0
+    # batches W draft+verify passes into ONE dispatched device program
+    # — the n-gram drafting, accept/reject, KV commits, budget
+    # freezing, and the pending-token chain all run in the scan, so
+    # the host round trip amortizes over up to W*(1+K) tokens instead
+    # of taxing every pass (the r05 paged-spec soft spot: 69.5 tok/s
+    # vs 1803 plain paged, one RTT per pass). Requires
+    # serving_speculative > 0 and the overlapped loop; an all-greedy
+    # batch rides windows, a sampled co-tenant falls back to the
+    # legacy per-pass path. Token streams are bit-identical either
+    # way. 0 = off (legacy per-pass speculation).
+    serving_spec_window: int = 0
     # Retry-after hint (seconds) carried by poisoned-pool refusals and
     # /healthz while degraded — what a refused client is told to wait
     # before retrying. When the recovery supervisor is active and a
@@ -543,6 +555,10 @@ class RuntimeConfig:
                     payload_doc.get("serving_overlap",
                                     cls.serving_overlap)
                 ),
+                serving_spec_window=int(
+                    payload_doc.get("serving_spec_window",
+                                    cls.serving_spec_window)
+                ),
                 serving_speculative=_parse_speculative(
                     payload_doc.get("serving_speculative",
                                     cls.serving_speculative)
@@ -707,6 +723,16 @@ class RuntimeConfig:
                 "[payload] serving_speculative (draft length) must be "
                 "in [0, 16] (0 = off) or 'auto'"
             )
+        if not 0 <= self.serving_spec_window <= 64:
+            raise RuntimeConfigError(
+                "[payload] serving_spec_window must be in [0, 64] "
+                "(0 = one spec pass per dispatch)"
+            )
+        if self.serving_spec_window > 0 and self.serving_speculative == 0:
+            raise RuntimeConfigError(
+                "[payload] serving_spec_window > 0 needs speculative "
+                "decoding (serving_speculative 'auto' or > 0)"
+            )
         if self.serving_retry_after_s <= 0:
             raise RuntimeConfigError(
                 "[payload] serving_retry_after_s must be > 0 "
@@ -841,6 +867,7 @@ class RuntimeConfig:
             f"serving_overlap = {s(self.serving_overlap)}\n"
             "serving_speculative = "
             f"{s(self.serving_speculative) if isinstance(self.serving_speculative, str) else self.serving_speculative}\n"
+            f"serving_spec_window = {self.serving_spec_window}\n"
             f"serving_retry_after_s = {self.serving_retry_after_s}\n"
             f"serving_recovery_attempts = {self.serving_recovery_attempts}\n"
             f"serving_sched_policy = {s(self.serving_sched_policy)}\n"

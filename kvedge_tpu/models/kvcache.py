@@ -94,40 +94,48 @@ class PagedCacheError(RuntimeError):
 
 
 _PAGED_KERNEL_AUTO_MIN_SEQ = 2048
-_PAGED_KERNEL_AUTO_MIN_PAGE = 64
 
 
 def _use_paged_kernel(cfg: TransformerConfig, page_size: int,
-                      width: int) -> bool:
-    """Resolve ``cfg.paged_attention`` at trace time (page_size/width
-    are static pool-shape facts under jit). "auto" picks the Pallas
-    block-table kernel exactly where it MEASURED faster on v5e
-    (BENCH_r05 long-context leg): TPU, long-context caps
-    (max_seq >= 2048), pages >= 64 tokens (the kernel's per-page DMA
-    loop is latency-bound — at 16-token pages its 4 KB copies lose to
-    XLA's bulk gather, ~1.17x WIN flips to ~0.6x loss), and
-    kv_heads*d_head % 128 == 0 (TPU DMA lane alignment; MHA at one kv
-    head takes the gather). The gather also keeps the short-context
-    default because the kernel is numerically equivalent but not
-    BIT-identical (it skips the gather's bf16 weight rounding; logits
-    agree to ~1e-2, measured), so the default path stays bit-stable
-    where the paged == contiguous exactness pin runs. Either choice
-    can be forced with "kernel"/"gather"; cfg is a static jit argument,
-    so changing the choice retraces rather than silently reusing a
-    cached program. Multi-process (slice) pools never auto-pick the
-    kernel: it has no partitioning rule, so tracing it over a sharded
-    pool would poison the first decode step on a real slice —
-    SlicePagedKVCache additionally pins its cfg to "gather" so even a
-    forced "kernel" cannot reach a sharded trace."""
+                      width: int, max_pages: int | None = None) -> bool:
+    """Resolve ``cfg.paged_attention`` at trace time (page_size/width/
+    max_pages are static pool-shape facts under jit). "auto" picks the
+    Pallas block-table kernel where it wins: TPU, long-context caps
+    (max_seq >= 2048), page_size % 128 == 0 (each page's score columns
+    land at lane offset j * page in the kernel's phase-2 scratch, which
+    Mosaic requires tile-aligned — and the per-page DMA loop is
+    latency-bound anyway: at 16-token pages its 4 KB copies lose to
+    XLA's bulk gather), kv_heads*d_head % 128 == 0 (TPU DMA lane
+    alignment; MHA at one kv head takes the gather), and the two-phase
+    kernel's VMEM scratch fitting the budget (over-cap pools route to
+    the gather). The kernel is BIT-IDENTICAL to the gather — it stages
+    the gather's own rounded score rows and runs the same softmax +
+    flat V contraction (pinned exactly in tests/test_paged_attention
+    .py) — so "auto" is a pure routing choice, never a numerics one;
+    short-context pools keep the gather only because the kernel's DMA
+    loop has nothing to win there. Either choice can be forced with
+    "kernel"/"gather"; cfg is a static jit argument, so changing the
+    choice retraces rather than silently reusing a cached program.
+    Multi-process (slice) pools never auto-pick the kernel: it has no
+    partitioning rule, so tracing it over a sharded pool would poison
+    the first decode step on a real slice — SlicePagedKVCache
+    additionally pins its cfg to "gather" so even a forced "kernel"
+    cannot reach a sharded trace."""
     if cfg.paged_attention == "kernel":
         return True
     if cfg.paged_attention == "gather":
         return False
+    from kvedge_tpu.ops.paged_attention import decode_scratch_fits_vmem
+
+    if max_pages is None:
+        max_pages = -(-cfg.max_seq // max(page_size, 1))
     return (jax.default_backend() == "tpu"
             and jax.process_count() == 1
             and cfg.max_seq >= _PAGED_KERNEL_AUTO_MIN_SEQ
-            and page_size >= _PAGED_KERNEL_AUTO_MIN_PAGE
-            and width % 128 == 0)
+            and page_size % 128 == 0
+            and width % 128 == 0
+            and decode_scratch_fits_vmem(
+                max_pages, page_size, width, cfg.n_heads))
 
 
 class PagedKVCache:
@@ -206,6 +214,17 @@ class PagedKVCache:
         # sliced on device, so dispatching N+1 never forces N's result
         # to the host.
         self._carry = None
+        # Device-resident speculative carry for the windowed-spec
+        # pipeline: (pending [slots], ctx [slots, S_ctx],
+        # ctx_len [slots]) of the most recent dispatch_spec_window.
+        # Unlike the greedy carry, the next window needs the whole
+        # drafting context, not just the last token row.
+        self._spec_carry = None
+        # Worst-case tokens per slot advanced by dispatched-but-not-yet
+        # -harvested spec windows. While any are in flight, the DEVICE
+        # lengths are data-dependent (acceptance counts the host learns
+        # only at harvest) and _sync must merge instead of clobber.
+        self._spec_unharvested = [0] * slots
 
     def _init_state(self, shape, dtype) -> PagedState:
         """Fresh zeroed device state. The slice-serving subclass
@@ -352,13 +371,30 @@ class PagedKVCache:
             self._unref(page)
         self._host_tables[slot] = [0] * self.max_pages_per_seq
         self._host_lengths[slot] = 0
+        # A released slot's device length must drop to 0 even while
+        # other slots' spec windows are in flight (the merge in _sync
+        # keeps only UNHARVESTED slots' device lengths).
+        self._spec_unharvested[slot] = 0
         self._sync()
 
     def _sync(self) -> None:
+        import numpy as _np
+
+        lengths = jnp.asarray(self._host_lengths, jnp.int32)
+        if any(self._spec_unharvested):
+            # Spec windows in flight advance their slots' DEVICE
+            # lengths by data-dependent acceptance counts the host
+            # learns only at harvest — a sync triggered by an unrelated
+            # admit/grow/release must keep those slots' device lengths,
+            # not clobber them with the stale host mirror.
+            mask = jnp.asarray(
+                _np.asarray(self._spec_unharvested) > 0
+            )
+            lengths = jnp.where(mask, self.state.lengths, lengths)
         self.state = dataclasses.replace(
             self.state,
             tables=jnp.asarray(self._host_tables, jnp.int32),
-            lengths=jnp.asarray(self._host_lengths, jnp.int32),
+            lengths=lengths,
         )
 
     # ---- data plane (device) --------------------------------------------
@@ -757,9 +793,14 @@ class PagedKVCache:
         return toks[n - 1]
 
     def drop_carry(self) -> None:
-        """Forget the device-resident carry (recovery: a revived pool
-        restarts its pipeline from host tokens)."""
+        """Forget the device-resident carries (recovery: a revived pool
+        restarts its pipelines from host tokens — greedy carry AND the
+        windowed-spec drafting context), and forget any unharvested
+        spec advance (the slots it covered are being torn down; their
+        host lengths are authoritative again)."""
         self._carry = None
+        self._spec_carry = None
+        self._spec_unharvested = [0] * self.slots
 
     def _device_window_dispatch(self, params, tokens, n_steps: int,
                                 active, steps_left):
@@ -841,6 +882,150 @@ class PagedKVCache:
             jnp.asarray(_np.asarray(spec_mask, bool)),
         )
         return emitted, accepted, logits0
+
+    # ---- windowed speculative decode (device-resident passes) -----------
+
+    def spec_window_caps(self, n_passes: int, k_len: int,
+                         budgets) -> "np.ndarray":
+        """Worst-case token advance per slot for ONE dispatched spec
+        window: a row runs verify passes while its remaining budget is
+        positive, each advancing 1 + accepted <= 1 + K, so the last
+        pass may overshoot the budget by up to K (the host truncates
+        the stream at harvest, exactly like the legacy per-pass path).
+        Pages, host inflight accounting, and ``_spec_unharvested`` all
+        reserve THIS bound; the true advance (the sum of the window's
+        acceptance counts) is only known at harvest."""
+        import numpy as _np
+
+        budgets_np = _np.maximum(
+            _np.asarray(budgets, _np.int64), 0
+        ).astype(_np.int32)
+        caps = _np.minimum(budgets_np + k_len, n_passes * (k_len + 1))
+        return _np.where(budgets_np > 0, caps, 0).astype(_np.int32)
+
+    def dispatch_spec_window(self, params, tokens, n_passes: int,
+                             k_len: int, budgets, active=None,
+                             ctx=None, ctx_len=None):
+        """Enqueue ``n_passes`` speculative draft+verify passes in ONE
+        device program, WITHOUT forcing the result.
+
+        The windowed twin of :meth:`step_spec`: drafting (the n-gram
+        proposer over a device-resident context), verification, KV
+        commits for accepted drafts, acceptance-capped freezing, and
+        the pending-token chain all run inside the scan — the host pays
+        one dispatch + one harvest for up to ``n_passes * (1 + K)``
+        tokens instead of one round trip per pass. Greedy rows only
+        (``budgets[b] > 0`` marks participants); sampled co-tenants
+        keep the legacy per-pass path.
+
+        First window of a pipeline: ``tokens`` [slots] int32 is each
+        row's pending token and ``ctx``/``ctx_len`` its drafting
+        context (prompt + generated + pending; [slots, S_ctx] /
+        [slots]). Subsequent windows pass ``tokens=None`` to ride the
+        device-resident spec carry — pending, context, and context
+        lengths never visit the host between back-to-back windows.
+
+        Returns an UNFORCED handle for :meth:`harvest_spec_window`.
+        Page growth and ``_spec_unharvested`` reserve the worst case
+        (:meth:`spec_window_caps`); host lengths advance only at
+        harvest, by the true acceptance counts.
+        """
+        import numpy as _np
+
+        slots = self._step_slots(active)
+        caps = self.spec_window_caps(n_passes, k_len, budgets)
+        budgets_np = _np.maximum(
+            _np.asarray(budgets, _np.int64), 0
+        ).astype(_np.int32)
+        grew = False
+        for slot in slots:
+            if caps[slot] > 0:
+                grew |= self.grow_to(
+                    slot, self._spec_unharvested[slot] + int(caps[slot])
+                )
+        if grew:
+            self._sync()
+        if tokens is None:
+            if self._spec_carry is None:
+                raise PagedCacheError(
+                    "no spec window in flight to carry from — the "
+                    "first spec window of a pipeline must pass "
+                    "explicit tokens and drafting context"
+                )
+        elif ctx is None or ctx_len is None:
+            raise PagedCacheError(
+                "a spec window dispatched from host tokens needs "
+                "its drafting context (ctx, ctx_len)"
+            )
+        emitted, counts, pend_out = self._device_spec_window(
+            params, tokens, n_passes, k_len, active, budgets_np,
+            ctx, ctx_len,
+        )
+        for slot in slots:
+            if caps[slot] > 0:
+                self._spec_unharvested[slot] += int(caps[slot])
+        return {
+            "emitted": emitted,      # [n_passes, slots, K+1], unforced
+            "counts": counts,        # [n_passes, slots], unforced
+            "pending": pend_out,     # [slots], unforced
+            "caps": caps,            # host worst-case reservation
+        }
+
+    def _device_spec_window(self, params, tokens, n_passes: int,
+                            k_len: int, active, budgets, ctx, ctx_len):
+        """Device seam: enqueue a windowed spec program (no read).
+        ``tokens=None`` rides the device-resident spec carry; the seam
+        owns the carry resolution AND the carry update, so a slice
+        override can broadcast the host inputs and keep a per-process
+        carry (runtime/sliceserve.py) with the base host bookkeeping
+        unchanged."""
+        import numpy as _np
+
+        if tokens is None:
+            pending, ctx_dev, ctx_len_dev = self._spec_carry
+        else:
+            pending = jnp.asarray(_np.asarray(tokens, _np.int32))
+            ctx_dev = jnp.asarray(_np.asarray(ctx, _np.int32))
+            ctx_len_dev = jnp.asarray(_np.asarray(ctx_len, _np.int32))
+        (emitted, counts, pend_out, ctx_out, ctx_len_out,
+         self.state) = _paged_spec_window(
+            params, self.state, pending, self.cfg, n_passes, k_len,
+            self._active_array(self.state, active),
+            jnp.asarray(_np.asarray(budgets, _np.int32)), ctx_dev,
+            ctx_len_dev,
+        )
+        self._spec_carry = (pend_out, ctx_out, ctx_len_out)
+        return emitted, counts, pend_out
+
+    def _force_spec_window(self, handle):
+        """Read a dispatched spec window's results to the host — the
+        blocking seam (a slice cache deadline-bounds it and reads its
+        local replicated shard)."""
+        import numpy as _np
+
+        return (_np.asarray(handle["emitted"]),
+                _np.asarray(handle["counts"]),
+                _np.asarray(handle["pending"]))
+
+    def harvest_spec_window(self, handle):
+        """Force a dispatched spec window to the host and settle the
+        bookkeeping its dispatch could only bound: host lengths advance
+        by each slot's TRUE acceptance-counted advance (the sum of its
+        per-pass counts), and the worst-case ``_spec_unharvested``
+        reservation is returned. Returns ``(emitted [n_passes, slots,
+        K+1], counts [n_passes, slots], pending [slots])`` as numpy."""
+        emitted, counts, pending = self._force_spec_window(handle)
+        caps = handle["caps"]
+        for slot in range(self.slots):
+            # A slot released (or released and re-admitted) while its
+            # window was in flight already had its bookkeeping zeroed —
+            # release()/drop_carry() are authoritative; settling here
+            # would resurrect a dead reservation.
+            if (caps[slot] > 0 and slot in self._pages_of
+                    and self._spec_unharvested[slot] >= int(caps[slot])):
+                self._host_lengths[slot] += int(counts[:, slot].sum())
+                self._spec_unharvested[slot] -= int(caps[slot])
+        return emitted, counts, pending
 
 
 # ---- jitted kernels ------------------------------------------------------
@@ -1017,7 +1202,8 @@ def _paged_attend_layer(cfg: TransformerConfig, state: PagedState, x,
     else:
         scales_fit = True
     if (kernel_eligible and scales_fit
-            and _use_paged_kernel(cfg, pool_k_l.shape[1], kv * dh)):
+            and _use_paged_kernel(cfg, pool_k_l.shape[1], kv * dh,
+                                  max_pages=tables.shape[1])):
         # Single-query decode (steps and windows): attention directly
         # over the block table — K/V pages stream up to each row's LIVE
         # length through the Pallas kernel; the padded pool view is
@@ -1210,6 +1396,95 @@ def _spec_verify_core(params: dict, state: PagedState, tokens,
 _paged_spec_verify = functools.partial(
     jax.jit, static_argnames=("cfg",), donate_argnums=(1,)
 )(_spec_verify_core)
+
+
+def _paged_spec_window_impl(params: dict, state: PagedState, tokens,
+                            cfg: TransformerConfig, n_passes: int,
+                            k_len: int, active, budgets, ctx, ctx_len):
+    """``n_passes`` speculative draft+verify passes in ONE program —
+    the windowed twin of :func:`_spec_verify_core`, with the host
+    removed from the loop entirely.
+
+    The legacy path pays a full host round trip per verify pass: read
+    back the emitted tokens, re-draft on the host, re-dispatch. Here
+    the scan carries everything that loop needed the host for:
+
+    * ``pending`` [B] — the pending-token chain (each pass's bonus
+      token feeds the next pass, exactly the legacy
+      ``req.next_token`` hand-off);
+    * ``ctx`` [B, S_ctx] / ``ctx_len`` [B] — the drafting context
+      (prompt + generated + pending). Each pass drafts K tokens with
+      the SAME n-gram proposer the host drafter mirrors
+      (models/speculative.py ``_propose_ngram``), appends its accepted
+      tokens + bonus, and drafts the next pass from the updated
+      context — so the windowed drafts equal the legacy host drafts
+      token for token, and (since greedy verify makes the emitted
+      stream independent of draft quality anyway) the emitted stream
+      is bit-identical to both the legacy spec path and plain greedy;
+    * ``rem`` [B] — each row's remaining emission budget. A pass runs
+      a row only while ``rem > 0``; a frozen row's scatters drop, its
+      length holds, and its pending/context freeze (the same
+      discipline as :func:`_paged_decode_window_capped_impl`), so a
+      speculatively dispatched window can never scribble past a stop
+      the host hasn't seen. The LAST live pass may overshoot the
+      budget by up to K accepted drafts — the host truncates at
+      harvest, exactly like the legacy per-pass path's ``room`` cap.
+
+    Each pass verifies through :func:`_spec_verify_core` (the single
+    jitted-pass body — windowed and per-pass spec stay the same
+    program, the invariant the windowed/per-step greedy pair already
+    keeps). Returns ``(emitted [n_passes, B, K+1], counts
+    [n_passes, B], pending [B], ctx, ctx_len, state)`` where
+    ``counts[p, b] = 1 + accepted`` for rows pass p advanced (0 for
+    frozen rows): row b's pass-p emissions are its pending token
+    followed by ``emitted[p, b, :counts[p, b] - 1]``, and
+    ``emitted[p, b, counts[p, b] - 1]`` is the next pending.
+    """
+    from kvedge_tpu.models.speculative import _propose_ngram
+
+    s_ctx = ctx.shape[1]
+
+    def body(carry, _):
+        state, pending, rem, ctx, ctx_len = carry
+        live = active & (rem > 0)
+        draft = jax.vmap(
+            lambda c, n: _propose_ngram(c, n, k_len)
+        )(ctx, ctx_len)
+        toks = jnp.concatenate([pending[:, None], draft], axis=1)
+        emitted, accepted, _logits0, state = _spec_verify_core(
+            params, state, toks, cfg, live, live
+        )
+        count = live.astype(jnp.int32) * (1 + accepted)
+        bonus = jnp.take_along_axis(
+            emitted, accepted[:, None], axis=1
+        )[:, 0]
+        pending = jnp.where(live, bonus, pending)
+        # Append this pass's a+1 new tokens (accepted drafts + bonus)
+        # to the drafting context; frozen rows' writes drop out of
+        # bounds. emitted[b, i] for i > accepted[b] repeats the bonus,
+        # so masking by offset <= accepted writes exactly the stream.
+        idx = jnp.arange(k_len + 1)[None, :]
+        pos = ctx_len[:, None] + idx
+        ok = live[:, None] & (idx <= accepted[:, None])
+        pos = jnp.where(ok, pos, s_ctx)
+        ctx = jax.vmap(
+            lambda c, p, e: c.at[p].set(e, mode="drop")
+        )(ctx, pos, emitted)
+        ctx_len = ctx_len + count
+        rem = rem - count
+        return (state, pending, rem, ctx, ctx_len), (emitted, count)
+
+    carry0 = (state, tokens, budgets, ctx, ctx_len)
+    (state, pending, _rem, ctx, ctx_len), (emitted, counts) = (
+        jax.lax.scan(body, carry0, length=n_passes)
+    )
+    return emitted, counts, pending, ctx, ctx_len, state
+
+
+_paged_spec_window = functools.partial(
+    jax.jit, static_argnames=("cfg", "n_passes", "k_len"),
+    donate_argnums=(1,),
+)(_paged_spec_window_impl)
 
 
 def _paged_decode_window_impl(params: dict, state: PagedState, tokens,

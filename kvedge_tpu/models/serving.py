@@ -213,7 +213,8 @@ class PagedGenerationServer:
     def __init__(self, params: dict, cfg, *, slots: int = 4,
                  pages: int = 64, page_size: int = 16,
                  prefill_chunk: int = 0, prefix_cache: bool = True,
-                 speculative: int = 0, window: int = 64,
+                 speculative: int = 0, spec_window: int = 0,
+                 window: int = 64,
                  kv_dtype: str = "", cache=None,
                  retry_after_s: float | None = None,
                  overlap: str = "auto", sched_policy: str = "strict",
@@ -299,6 +300,35 @@ class PagedGenerationServer:
         self._spec_passes = 0
         self._spec_emitted = 0      # tokens emitted by greedy slots
         self._spec_slot_passes = 0  # greedy-slot participations
+        # Device-resident spec windows ([payload] serving_spec_window,
+        # SERVING.md rung 20): W > 0 batches W draft+verify passes into
+        # ONE dispatched device program — drafting, accept/reject, KV
+        # commits, budget freezing, and the pending-token chain all run
+        # in the scan, so the host RTT amortizes over up to W*(1+K)
+        # tokens instead of taxing every pass. Requires spec mode
+        # (speculative > 0); an all-greedy active set rides windows,
+        # any sampled co-tenant falls back to the legacy per-pass path
+        # (identical tokens either way — windows are a scheduling
+        # change, not a semantic one).
+        if spec_window < 0:
+            raise ValueError("spec_window must be >= 0")
+        if spec_window > 0 and self._spec <= 0:
+            raise ValueError(
+                "spec_window needs speculative mode (speculative > 0)"
+            )
+        self._spec_window = int(spec_window)
+        self._spec_windows = 0
+        # Drafting-context capacity for the device-resident proposer:
+        # prompt + generated + pending never exceeds max_seq + 1, and
+        # the device appends at most K past the budget before freezing.
+        self._spec_ctx_cap = int(cfg.max_seq) + int(speculative) + 2
+        # Per-window emitted-tokens histogram (tokens a single request
+        # realized from one dispatched spec window, post-truncation) —
+        # the in-window acceptance E the rung-20 perf model needs, and
+        # the Perfetto counterpart showing logical passes per dispatch.
+        self._hist_spec_tokens = _Hist(
+            (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+        )
         # Chunked prefill granule (0 = whole-prompt): long prompts land
         # in fixed-size chunks with the lock RELEASED between chunks, so
         # in-flight requests keep decoding during an admission and XLA
@@ -1454,6 +1484,16 @@ class PagedGenerationServer:
                 out["spec_emitted_per_pass"] = round(
                     self._spec_emitted / self._spec_slot_passes, 3
                 ) if self._spec_slot_passes else 0.0
+            if self._spec_window:
+                # Device-resident spec windows (SERVING.md rung 20):
+                # the knob, the dispatch count, and the per-window
+                # emitted-tokens histogram (in-window acceptance E —
+                # logical passes per dispatch for the Perfetto view).
+                out["spec_window"] = self._spec_window
+                out["spec_windows_total"] = self._spec_windows
+                out["spec_window_emitted_tokens"] = (
+                    self._hist_spec_tokens.snapshot()
+                )
             if self._spec_decision is not None:
                 # The boot-time economics decision (resolve_speculation)
                 # — present even after an auto fallback zeroed _spec, so
@@ -2071,10 +2111,25 @@ class PagedGenerationServer:
                     if (self._spec > 0
                             and any(req.sampling is None
                                     for req in self._active.values())):
-                        # Speculative passes need the host between
-                        # every device call (drafting reads emitted
-                        # tokens) — they run at boundaries only and
-                        # never overlap.
+                        if (self._spec_window > 0
+                                and all(req.sampling is None
+                                        for req in
+                                        self._active.values())):
+                            # Device-resident spec windows: draft +
+                            # verify + accept/reject run IN the
+                            # dispatched scan, so spec mode joins the
+                            # double-buffered pipeline instead of
+                            # forcing a boundary per pass.
+                            self._inflight = (
+                                self._dispatch_spec_window_locked(
+                                    first=True
+                                )
+                            )
+                            return "ran"
+                        # Legacy per-pass speculation (or a sampled
+                        # co-tenant in the batch): drafting reads
+                        # emitted tokens on the host, so passes run at
+                        # boundaries only and never overlap.
                         self._spec_pass()
                         return "ran"
                     self._inflight = self._dispatch_window_locked(
@@ -2087,16 +2142,34 @@ class PagedGenerationServer:
                         # Enqueue N+1 on the carry BEFORE touching
                         # N's result — the device starts N+1 the
                         # moment N retires, while the host is still
-                        # in _harvest_locked below.
-                        self._inflight = self._dispatch_window_locked(
-                            first=False
-                        )
+                        # in the harvest below. The next window rides
+                        # the SAME carry kind as the previous one
+                        # (plain and spec carries are separate device
+                        # state); a kind change joins at a boundary.
+                        if prev.get("kind") != "spec":
+                            self._inflight = (
+                                self._dispatch_window_locked(
+                                    first=False
+                                )
+                            )
+                        elif (self._spec > 0
+                              and self._spec_window > 0):
+                            self._inflight = (
+                                self._dispatch_spec_window_locked(
+                                    first=False
+                                )
+                            )
+                        # else: speculation was disabled with a spec
+                        # window in flight — collapse to a boundary.
                     elif self.tracer is not None:
                         # Overlap boundary: the pipeline collapses so a
                         # cancel/newcomer/swap can join reconciled.
                         self.tracer.event("boundary", "serve",
                                           args={"reason": "reconcile"})
-                    self._harvest_locked(prev)
+                    if prev.get("kind") == "spec":
+                        self._harvest_spec_window_locked(prev)
+                    else:
+                        self._harvest_locked(prev)
                 except Exception:
                     # prev was not reconciled — restore its inflight
                     # accounting and drain it with whatever else is
@@ -2263,6 +2336,124 @@ class PagedGenerationServer:
         self._overlap_windows += 1
         self._hist_host.observe((time.perf_counter() - t_host) * 1e3)
 
+    def _dispatch_spec_window_locked(self, first: bool) -> dict | None:
+        """Enqueue one device-resident spec window — ``_spec_window``
+        draft+verify passes in a single dispatched program — for every
+        active greedy slot with budget remaining (lock held); returns
+        the in-flight record (``kind="spec"``), or None when no slot
+        can advance.
+
+        ``first`` distinguishes the boundary dispatch (host-known
+        pending tokens plus each row's drafting context: prompt +
+        generated + pending) from the overlapped dispatch
+        (``tokens=None`` — pending, context, and context lengths ride
+        the device-resident spec carry). The per-row budget is
+        ``n_new - len(generated) - inflight`` — the pending token is
+        CONSUMED by the window (each pass emits it), unlike the plain
+        window path's stepless finish-check emission, so there is no
+        ``- 1``. The request's ``inflight`` advances by the cache's
+        worst-case cap (``min(budget + K, W*(1+K))``); the true
+        advance lands at harvest, truncated at the budget exactly like
+        the legacy per-pass path's room cap.
+        """
+        k = self._spec
+        w = self._spec_window
+        n = self._cache.slots
+        budgets = np.zeros((n,), np.int32)
+        parts = []
+        for slot, req in self._active.items():
+            room = req.n_new - len(req.generated) - req.inflight
+            if room > 0:
+                budgets[slot] = room
+                parts.append((slot, req))
+        if not parts:
+            return None
+        if first:
+            ctx = np.zeros((n, self._spec_ctx_cap), np.int32)
+            ctx_len = np.zeros((n,), np.int32)
+            tokens = np.zeros((n,), np.int32)
+            for slot, req in parts:
+                seq = req.prompt + req.generated + [req.next_token]
+                ctx[slot, :len(seq)] = seq
+                ctx_len[slot] = len(seq)
+                tokens[slot] = req.next_token
+            handle = self._cache.dispatch_spec_window(
+                self._params, tokens, w, k, budgets,
+                ctx=ctx, ctx_len=ctx_len,
+            )
+        else:
+            handle = self._cache.dispatch_spec_window(
+                self._params, None, w, k, budgets
+            )
+        recs = []
+        for slot, req in parts:
+            cap = int(handle["caps"][slot])
+            req.inflight += cap
+            recs.append((slot, req, cap))
+        self._hist_depth.observe(0.0 if first else 1.0)
+        return {"kind": "spec", "window": w, "parts": recs,
+                "handle": handle, "depth": 0 if first else 1,
+                "t0": time.perf_counter()}
+
+    def _harvest_spec_window_locked(self, rec: dict) -> None:
+        """Force an in-flight spec window's results and reconcile
+        (lock held). Each row replays its pending-token chain — pass
+        ``p`` emits the pending token plus the accepted drafts
+        (``counts[p] - 1`` of the emitted row; the final entry is the
+        next pending) — truncated at the row's remaining budget, so a
+        device-side overshoot (the last live pass may exceed the
+        budget by up to K) never over-emits, exactly like the legacy
+        path's room cap."""
+        emitted, counts, _pending = self._cache.harvest_spec_window(
+            rec["handle"]
+        )
+        t_harvest = time.perf_counter()
+        self._hist_rtt.observe((t_harvest - rec["t0"]) * 1e3)
+        if self.tracer is not None:
+            self.tracer.span(
+                "spec-window", "serve", rec["t0"], t_harvest,
+                args={"w": rec["window"],
+                      "rows": len(rec["parts"]),
+                      "depth": rec.get("depth", 0)},
+            )
+        t_host = time.perf_counter()
+        rec["counted"] = True
+        for _, req, cap in rec["parts"]:
+            req.inflight -= cap
+        self._spec_passes += rec["window"]
+        for slot, req, cap in rec["parts"]:
+            if self._active.get(slot) is not req:
+                # Released while in flight (normally unreachable —
+                # cancels resolve at boundaries); nothing to emit into.
+                continue
+            before = len(req.generated)
+            for p in range(rec["window"]):
+                c = int(counts[p, slot])
+                if c == 0:
+                    # Frozen pass: the row's budget ran out on device
+                    # (rem <= 0) — no tokens, no pending advance.
+                    continue
+                room = max(req.n_new - len(req.generated), 0)
+                seq = [req.next_token] + [
+                    int(t) for t in emitted[p, slot, :c - 1]
+                ]
+                for t in seq[:room]:
+                    self._emit(req, t)
+                req.next_token = int(emitted[p, slot, c - 1])
+                self._spec_emitted += min(len(seq), room)
+                self._spec_slot_passes += 1
+            self._hist_spec_tokens.observe(
+                float(len(req.generated) - before)
+            )
+            if len(req.generated) >= req.n_new and not req.cancelled:
+                # Inline finish, as in the plain harvest: a saturated
+                # pipeline may never visit a boundary. The cancelled
+                # guard preserves cancel-beats-finish ordering.
+                self._finish_request_locked(slot, req)
+        self._spec_windows += 1
+        self._overlap_windows += 1
+        self._hist_host.observe((time.perf_counter() - t_host) * 1e3)
+
     def _drain_rec_locked(self, rec: dict | None) -> None:
         """Unwind one in-flight record on the failure path: restore
         the inflight counters and block (deadline-bounded for a slice
@@ -2276,7 +2467,10 @@ class PagedGenerationServer:
             for _, req, adv in rec["parts"]:
                 req.inflight -= adv
         try:
-            self._cache.harvest_window(rec["handle"])
+            if rec.get("kind") == "spec":
+                self._cache.harvest_spec_window(rec["handle"])
+            else:
+                self._cache.harvest_window(rec["handle"])
         except Exception:
             pass
 

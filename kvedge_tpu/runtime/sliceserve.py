@@ -73,6 +73,7 @@ from kvedge_tpu.models.kvcache import (
     _paged_decode_window_sampled_capped_impl,
     _paged_decode_window_sampled_impl,
     _paged_prefill_impl,
+    _paged_spec_window_impl,
     _scatter_pages_impl,
     _spec_verify_core,
 )
@@ -84,7 +85,8 @@ from kvedge_tpu.models.kvcache import (
 # block on a result (they never read tokens at all). New codes append
 # at the end: the numbering is wire protocol.
 (OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW, OP_SPEC,
- OP_WSAMPLE, OP_WINDOWP, OP_WSAMPLEP, OP_SWAPOUT, OP_SWAPIN) = range(11)
+ OP_WSAMPLE, OP_WINDOWP, OP_WSAMPLEP, OP_SWAPOUT, OP_SWAPIN,
+ OP_SPECW) = range(12)
 _HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
 
 # Human names for follower-side replay spans (runtime/tracing.py).
@@ -93,7 +95,7 @@ _OP_NAMES = {
     OP_STEP: "step", OP_WINDOW: "window", OP_SPEC: "spec",
     OP_WSAMPLE: "wsample", OP_WINDOWP: "windowp",
     OP_WSAMPLEP: "wsamplep", OP_SWAPOUT: "swapout",
-    OP_SWAPIN: "swapin",
+    OP_SWAPIN: "swapin", OP_SPECW: "specw",
 }
 
 
@@ -160,6 +162,16 @@ def _slice_kernels(mesh, cfg, quantized: bool = False):
         static_argnames=("cfg", "n_steps"), donate_argnums=(1,),
         out_shardings=(rep, state_sh),
     )
+    # Device-resident spec windows (SERVING.md rung 20): emitted,
+    # counts, and the pending/context carry all pin REPLICATED so the
+    # leader host-reads results from its shard and every process holds
+    # its own copy of the carry for the next window's dispatch.
+    specw = jax.jit(
+        _paged_spec_window_impl,
+        static_argnames=("cfg", "n_passes", "k_len"),
+        donate_argnums=(1,),
+        out_shardings=(rep, rep, rep, rep, rep, state_sh),
+    )
     # Preemptive swap (SERVING.md rung 17): the gather pins REPLICATED
     # outputs — an all-gather over the model-sharded pool dims, so the
     # leader can host-read the as-stored page bytes; the scatter takes
@@ -171,7 +183,8 @@ def _slice_kernels(mesh, cfg, quantized: bool = False):
         _scatter_pages_impl, donate_argnums=(0,), out_shardings=state_sh,
     )
     return (rep, state_sh, prefill, step, window, spec, wsample,
-            window_capped, wsample_capped, swap_gather, swap_scatter)
+            window_capped, wsample_capped, swap_gather, swap_scatter,
+            specw)
 
 
 class SlicePagedKVCache(PagedKVCache):
@@ -209,7 +222,8 @@ class SlicePagedKVCache(PagedKVCache):
         (self._rep, self._state_sh, self._k_prefill, self._k_step,
          self._k_window, self._k_spec, self._k_wsample,
          self._k_window_capped, self._k_wsample_capped,
-         self._k_swapout, self._k_swapin) = _slice_kernels(
+         self._k_swapout, self._k_swapin,
+         self._k_specw) = _slice_kernels(
              mesh, cfg, quantized=kv_dtype == "int8"
          )
         self._is_leader = jax.process_index() == 0
@@ -663,6 +677,75 @@ class SlicePagedKVCache(PagedKVCache):
         return (self._read(emitted), self._read(accepted),
                 self._read(logits0))
 
+    def _device_spec_window(self, params, tokens, n_passes: int,
+                            k_len: int, active, budgets, ctx, ctx_len):
+        """Leader: broadcast + enqueue one device-resident spec window
+        WITHOUT reading the result (the windowed twin of OP_WINDOWP).
+        ``tokens=None`` selects the device-resident spec carry —
+        pending token, drafting context, and context lengths from the
+        previous window, which every process holds replicated from its
+        own execution, so nothing blocks between back-to-back windows.
+        Header ``c`` carries the drafting-context width (0 = carry, so
+        followers know which payload template to expect)."""
+        self._check_live()
+        carry = tokens is None
+        if carry:
+            tokens_np = np.zeros((self.slots,), np.int32)
+            ctx_np = np.zeros((self.slots, 1), np.int32)
+            ctx_len_np = np.zeros((self.slots,), np.int32)
+            width = 0
+        else:
+            tokens_np = np.asarray(tokens, np.int32)
+            ctx_np = np.asarray(ctx, np.int32)
+            ctx_len_np = np.asarray(ctx_len, np.int32)
+            width = int(ctx_np.shape[1])
+        mask = self._active_np(active)
+        budgets_np = np.asarray(budgets, np.int32)
+
+        def op():
+            self._send_header(OP_SPECW, n_passes, k_len, width)
+            payload = self._bcast(
+                (tokens_np, mask, budgets_np, ctx_np, ctx_len_np)
+            )
+            return self._exec_spec_window(
+                params, *(np.asarray(x) for x in payload),
+                n_passes=n_passes, k_len=k_len, carry=carry,
+            )
+
+        return self._traced_run(("specw", n_passes, k_len), op)
+
+    def _exec_spec_window(self, params, tokens: np.ndarray,
+                          mask: np.ndarray, budgets: np.ndarray,
+                          ctx: np.ndarray, ctx_len: np.ndarray, *,
+                          n_passes: int, k_len: int, carry: bool):
+        if carry:
+            pending, ctx_dev, ctx_len_dev = self._spec_carry
+        else:
+            pending = self._global(tokens.astype(np.int32))
+            ctx_dev = self._global(ctx.astype(np.int32))
+            ctx_len_dev = self._global(ctx_len.astype(np.int32))
+        (emitted, counts, pend_out, ctx_out, ctx_len_out,
+         self.state) = self._k_specw(
+            params, self.state, pending, self.cfg, n_passes, k_len,
+            self._global(mask.astype(bool)),
+            self._global(budgets.astype(np.int32)),
+            ctx_dev, ctx_len_dev,
+        )
+        self._spec_carry = (pend_out, ctx_out, ctx_len_out)
+        return emitted, counts, pend_out
+
+    def _force_spec_window(self, handle):
+        """Leader: force a dispatched spec window's results. Like
+        ``harvest_window``: deadline-bounded but NOT a broadcast — the
+        outputs are replicated and followers never read them."""
+        self._check_live()
+        return self._traced_run(
+            ("specwharvest",),
+            lambda: (self._read(handle["emitted"]),
+                     self._read(handle["counts"]),
+                     self._read(handle["pending"])),
+        )
+
     def stop(self) -> None:
         """Leader: release the followers (end of serve). Idempotent —
         the serving layer calls this from ``close()`` UNDER the server
@@ -840,6 +923,22 @@ class SlicePagedKVCache(PagedKVCache):
             self._exec_window_sampled_pipelined(
                 params, *(np.asarray(x) for x in payload), n_steps=a,
                 carry=bool(c),
+            )
+        elif op == OP_SPECW:
+            # a = n_passes, b = k_len, c = drafting-context width
+            # (0 = device-resident carry; a width-1 placeholder still
+            # rides the broadcast so the payload shape is fixed).
+            width = c if c > 0 else 1
+            payload = self._bcast((
+                np.zeros((self.slots,), np.int32),
+                np.zeros((self.slots,), bool),
+                np.zeros((self.slots,), np.int32),
+                np.zeros((self.slots, width), np.int32),
+                np.zeros((self.slots,), np.int32),
+            ))
+            self._exec_spec_window(
+                params, *(np.asarray(x) for x in payload),
+                n_passes=a, k_len=b, carry=c == 0,
             )
         elif op == OP_SWAPOUT:
             # a = page count. The gather's replicated result is

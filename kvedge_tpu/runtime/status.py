@@ -115,6 +115,15 @@ _SERVE_METRIC_FIELDS = (
     ("spec_emitted_per_pass", "serve_spec_emitted_per_pass", "gauge",
      "mean greedy tokens emitted per verify pass — the realized "
      "speculative acceleration (paged backend)"),
+    # Device-resident spec windows (SERVING.md rung 20): W draft+
+    # verify passes per dispatch, so the host RTT amortizes over up to
+    # W*(1+K) tokens instead of taxing every pass.
+    ("spec_window", "serve_spec_window", "gauge",
+     "speculative passes batched per device dispatch (paged backend, "
+     "serving_spec_window > 0; absent = windows off)"),
+    ("spec_windows_total", "serve_spec_windows_total", "counter",
+     "device-resident speculative windows harvested (paged backend, "
+     "serving_spec_window)"),
     # Failure surface (runtime/failures.py): 1 once the pool has been
     # poisoned by a serving failure. With the recovery supervisor active
     # (runtime/recovery.py) this clears again after a successful heal —
@@ -202,6 +211,10 @@ _SERVE_HISTOGRAM_FIELDS = (
     ("window_inflight_depth", "serve_window_inflight_depth",
      "pipeline depth observed at each window dispatch (0 = boundary "
      "dispatch, 1 = overlapped dispatch)"),
+    ("spec_window_emitted_tokens", "serve_spec_window_emitted_tokens",
+     "tokens a request realized from one device-resident speculative "
+     "window (serving_spec_window; low buckets mean drafts are not "
+     "landing and the window is mostly frozen passes)"),
     ("sched_queue_wait_ms_interactive",
      "serve_sched_queue_wait_ms_interactive",
      "admission queue wait in ms for interactive-class requests "

@@ -1274,6 +1274,14 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 prefill_chunk=cfg.serving_prefill_chunk,
                 prefix_cache=cfg.serving_prefix_cache,
                 speculative=spec_draft,
+                # Device-resident spec windows (SERVING.md rung 20):
+                # only meaningful when spec_draft resolved > 0 — the
+                # server validates the pairing, and _spec_draft_len
+                # already pins "auto" before construction, so a zero
+                # draft with a nonzero window is a config error here,
+                # not a silent fallback.
+                spec_window=(cfg.serving_spec_window
+                             if spec_draft > 0 else 0),
                 window=cfg.serving_window,
                 kv_dtype=cfg.serving_kv_dtype,
                 cache=cache,

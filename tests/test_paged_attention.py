@@ -2,11 +2,14 @@
 
 The kernel (ops/paged_attention.py) computes decode attention directly
 over the block table; the gather path materializes the padded pool view
-(kvcache._gathered). The two must agree: same math, different streaming.
-On CPU the kernel runs under the Pallas interpreter (cfg.paged_attention
-= "kernel" forces it; "auto" resolves to the gather here), which is how
-these tests pin it without TPU hardware; the bench's long-context leg
-re-asserts token equality on the real chip before timing.
+(kvcache._gathered). The contract is BIT-IDENTITY, not tolerance: the
+two-phase kernel stages the gather's own rounded score rows and runs
+the same softmax + flat V contraction, so every comparison here is
+exact (raw-bits equality). On CPU the kernel runs under the Pallas
+interpreter (cfg.paged_attention = "kernel" forces it; "auto" resolves
+to the gather here), which is how these tests pin it without TPU
+hardware; the bench's long-context leg re-asserts the same bit-identity
+on the real chip before timing.
 """
 
 import dataclasses
@@ -32,39 +35,119 @@ def params():
     return init_params(jax.random.PRNGKey(0), CFG)
 
 
-def test_kernel_matches_gather_math_ragged_lengths():
-    """Raw op check: block-table streaming == padded gather + einsum,
-    across rows whose live lengths span <1 page to several pages (dead
-    pages in between must contribute nothing)."""
-    B, H, KV, Dh, page, P, MP = 3, 8, 2, 64, 16, 12, 4
+def _gather_reference(q, pool_k, pool_v, tables, q_pos):
+    """kvcache._paged_attend_layer's gather math at q_len == 1, inlined
+    shape-for-shape (the einsum dims, mask, softmax upcast, and weight
+    rounding all match the serving path) — the thing the kernel must
+    reproduce BITWISE, not approximately."""
+    B, H, Dh = q.shape
+    _, page, KV, _ = pool_k.shape
+    MP = tables.shape[1]
     G = H // KV
-    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    k = pool_k[tables].reshape(B, MP * page, KV, Dh)
+    v = pool_v[tables].reshape(B, MP * page, KV, Dh)
+    qg = q.reshape(B, 1, KV, G, Dh)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k) / (Dh ** 0.5)
+    allowed = jnp.arange(MP * page)[None, :] <= q_pos[:, None]
+    s = jnp.where(allowed[:, None, None, None], s, jnp.finfo(q.dtype).min)
+    w = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
+    att = jnp.einsum("bkgqs,bskd->bqkgd", w, v)
+    return att.reshape(B, 1, H, Dh)[:, 0]
+
+
+def _assert_bit_identical(got, want):
+    """Exact equality, compared as raw bits: any tolerance here would
+    let the 0.92-agreement regression (r05) back in."""
+    got16 = np.asarray(got).view(np.uint16)
+    want16 = np.asarray(want).view(np.uint16)
+    np.testing.assert_array_equal(got16, want16)
+
+
+def _ragged_pool(B, H, KV, Dh, page, q_pos_list, seed=0):
+    """Random pool + block tables whose rows live exactly through
+    ``q_pos_list`` (page 0 left as the shared dead-page alias)."""
+    MP = max(qp // page + 1 for qp in q_pos_list) + 1
+    P = sum(qp // page + 1 for qp in q_pos_list) + 1
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(seed), 3)
     q = jax.random.normal(kq, (B, H, Dh), jnp.bfloat16)
     pool_k = jax.random.normal(kk, (P, page, KV, Dh), jnp.bfloat16)
     pool_v = jax.random.normal(kv_, (P, page, KV, Dh), jnp.bfloat16)
-    tables = jnp.asarray(
-        [[1, 2, 3, 0], [4, 5, 0, 0], [6, 0, 0, 0]], jnp.int32
-    )
-    q_pos = jnp.asarray([40, 17, 3], jnp.int32)
+    tables = np.zeros((B, MP), np.int32)
+    nxt = 1
+    for b, qp in enumerate(q_pos_list):
+        for j in range(qp // page + 1):
+            tables[b, j] = nxt
+            nxt += 1
+    return (q, pool_k, pool_v, jnp.asarray(tables),
+            jnp.asarray(q_pos_list, jnp.int32))
 
-    k = pool_k[tables].reshape(B, MP * page, KV, Dh)
-    v = pool_v[tables].reshape(B, MP * page, KV, Dh)
-    qg = q.reshape(B, KV, G, Dh)
-    s = jnp.einsum("bkgd,bskd->bkgs", qg, k) / (Dh ** 0.5)
-    allowed = jnp.arange(MP * page)[None, :] <= q_pos[:, None]
-    s = jnp.where(allowed[:, None, None], s, jnp.finfo(q.dtype).min)
-    w = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
-    want = np.asarray(
-        jnp.einsum("bkgs,bskd->bkgd", w, v).reshape(B, H, Dh),
-        np.float32,
-    )
 
-    got = np.asarray(paged_decode_attention(
-        q, pool_k, pool_v, tables, q_pos, interpret=True
-    ), np.float32)
-    # One bf16 ulp of slack: the kernel's online softmax accumulates in
-    # a different order than the row-wise softmax.
-    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+def test_kernel_matches_gather_bitwise_ragged_lengths():
+    """Raw op check: block-table streaming == padded gather + einsum,
+    BIT-FOR-BIT, across rows whose live lengths span <1 page to several
+    pages (dead pages in between must contribute nothing)."""
+    q, pool_k, pool_v, tables, q_pos = _ragged_pool(
+        3, 8, 2, 64, 16, [40, 17, 3])
+    want = _gather_reference(q, pool_k, pool_v, tables, q_pos)
+    got = paged_decode_attention(
+        q, pool_k, pool_v, tables, q_pos, interpret=True)
+    _assert_bit_identical(got, want)
+
+
+@pytest.mark.window
+def test_kernel_bitwise_at_page_boundary_and_longctx():
+    """The r05 regression pinned forever: at live lengths straddling a
+    page boundary (511/512/513, page 128 — partial page, exact page,
+    one-past) and at live 4096, the kernel output equals the gather's
+    bit-for-bit. The old online-softmax kernel disagreed here
+    (paged_longctx_token_agreement = 0.92 at live 512)."""
+    q, pool_k, pool_v, tables, q_pos = _ragged_pool(
+        3, 8, 2, 64, 128, [510, 511, 512])
+    want = _gather_reference(q, pool_k, pool_v, tables, q_pos)
+    got = paged_decode_attention(
+        q, pool_k, pool_v, tables, q_pos, interpret=True)
+    _assert_bit_identical(got, want)
+
+    q, pool_k, pool_v, tables, q_pos = _ragged_pool(
+        1, 8, 2, 64, 128, [4095], seed=1)
+    want = _gather_reference(q, pool_k, pool_v, tables, q_pos)
+    got = paged_decode_attention(
+        q, pool_k, pool_v, tables, q_pos, interpret=True)
+    _assert_bit_identical(got, want)
+
+
+@pytest.mark.window
+def test_kernel_bitwise_int8_pool():
+    """The int8 variant dequantizes pages in VMEM with the gather's
+    exact elementwise formula before any compute — so it too is
+    bit-identical, including at a page boundary."""
+    B, H, KV, Dh, page = 2, 8, 2, 64, 128
+    MP, P = 5, 9
+    keys = jax.random.split(jax.random.PRNGKey(7), 5)
+    q = jax.random.normal(keys[0], (B, H, Dh), jnp.bfloat16)
+    pool_k = jax.random.randint(keys[1], (P, page, KV, Dh), -127, 128,
+                                jnp.int8)
+    pool_v = jax.random.randint(keys[2], (P, page, KV, Dh), -127, 128,
+                                jnp.int8)
+    sk = jax.random.uniform(keys[3], (P, page, KV), jnp.float32,
+                            0.001, 0.02)
+    sv = jax.random.uniform(keys[4], (P, page, KV), jnp.float32,
+                            0.001, 0.02)
+    tables = jnp.asarray([[1, 2, 3, 4, 0], [5, 6, 0, 0, 0]], jnp.int32)
+    q_pos = jnp.asarray([512, 255], jnp.int32)
+
+    k = (pool_k[tables].astype(jnp.float32)
+         * sk[tables][..., None]).astype(jnp.bfloat16)
+    v = (pool_v[tables].astype(jnp.float32)
+         * sv[tables][..., None]).astype(jnp.bfloat16)
+    want = _gather_reference(
+        q, k.reshape(B * MP, page, KV, Dh),
+        v.reshape(B * MP, page, KV, Dh),
+        jnp.arange(B * MP, dtype=jnp.int32).reshape(B, MP), q_pos)
+    got = paged_decode_attention(
+        q, pool_k, pool_v, tables, q_pos,
+        scale_k=sk, scale_v=sv, interpret=True)
+    _assert_bit_identical(got, want)
 
 
 def _greedy_tokens(cfg, params, prompts, n_new):
@@ -101,6 +184,43 @@ def test_cache_decode_kernel_equals_gather_tokens(params):
     assert kernel.tolist() == gather.tolist()
 
 
+@pytest.mark.window
+def test_longctx_token_agreement_at_page_boundaries():
+    """End to end through PagedKVCache at prompt lengths straddling a
+    page boundary (511/512/513 at page 128): windowed greedy decode
+    under 'kernel' and 'gather' produces IDENTICAL tokens — the
+    bench's ``paged_longctx_token_agreement`` must be 1.0, and this is
+    the tier-1 pin that keeps the r05 0.92 from silently returning."""
+    long_cfg = dataclasses.replace(CFG, max_seq=640)
+    long_params = init_params(jax.random.PRNGKey(1), long_cfg)
+    prompts = [
+        np.asarray(jax.random.randint(
+            jax.random.PRNGKey(10 + n), (n,), 0, long_cfg.vocab
+        ), np.int32).tolist()
+        for n in (511, 512, 513)
+    ]
+
+    def tokens(cfg):
+        cache = PagedKVCache(cfg, slots=3, pages=18, page_size=128)
+        pend = np.zeros((3,), np.int32)
+        for s, p in enumerate(prompts):
+            cache.admit(s, len(p))
+            logits = cache.prefill(
+                long_params, s, jnp.asarray(p, jnp.int32))
+            pend[s] = int(jnp.argmax(logits))
+        produced = np.asarray(cache.step_window(
+            long_params, jnp.asarray(pend), 12))
+        return np.concatenate([pend[None], produced])
+
+    gather = tokens(long_cfg)
+    kernel = tokens(dataclasses.replace(long_cfg,
+                                        paged_attention="kernel"))
+    agreement = float(np.mean(kernel == gather))
+    assert agreement == 1.0, (
+        f"paged_longctx_token_agreement regressed to {agreement}"
+    )
+
+
 def test_spec_and_prefill_paths_unaffected_by_kernel_flag(params):
     """The verify pass and prefill are multi-query — they keep the
     gather path, so spec decoding under the kernel flag still matches
@@ -130,9 +250,9 @@ def test_auto_never_picks_kernel_multiprocess(monkeypatch):
 
     cfg = dataclasses.replace(CFG, paged_attention="auto", max_seq=4096)
     monkeypatch.setattr(kvmod.jax, "default_backend", lambda: "tpu")
-    assert kvmod._use_paged_kernel(cfg, 64, 256)
+    assert kvmod._use_paged_kernel(cfg, 128, 256)
     monkeypatch.setattr(kvmod.jax, "process_count", lambda: 2)
-    assert not kvmod._use_paged_kernel(cfg, 64, 256)
+    assert not kvmod._use_paged_kernel(cfg, 128, 256)
 
 
 def test_vmem_refusal_spares_gather_only_traces(params, monkeypatch):

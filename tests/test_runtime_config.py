@@ -83,6 +83,31 @@ def test_serving_pool_knobs_round_trip_and_validate():
             RuntimeConfig.parse(f"[payload]\n{bad}\n")
 
 
+def test_serving_spec_window_round_trips_and_validates():
+    cfg = RuntimeConfig.parse(
+        "[payload]\nserving = 'paged'\nserving_speculative = 4\n"
+        "serving_spec_window = 8\n"
+    )
+    assert cfg.serving_spec_window == 8
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    assert RuntimeConfig.parse("").serving_spec_window == 0  # off
+    # "auto" speculation may still carry a window (the boot probe can
+    # keep or drop speculation; the window follows it).
+    auto = RuntimeConfig.parse(
+        "[payload]\nserving_speculative = 'auto'\n"
+        "serving_spec_window = 4\n"
+    )
+    assert auto.serving_spec_window == 4
+    for bad in (
+        "serving_spec_window = -1",
+        "serving_spec_window = 65",
+        # Windows without speculation have no drafts to run.
+        "serving_spec_window = 4",
+    ):
+        with pytest.raises(RuntimeConfigError):
+            RuntimeConfig.parse(f"[payload]\n{bad}\n")
+
+
 def test_model_section_parses_and_round_trips():
     cfg = RuntimeConfig.parse(
         "[model]\npreset = \"flagship\"\nn_kv_heads = 2\nexperts = 4\n"

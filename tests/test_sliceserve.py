@@ -227,6 +227,30 @@ def test_slice_server_speculative_matches_reference(params, mesh):
         server.close()
 
 
+@pytest.mark.window
+def test_slice_server_spec_window_matches_reference(params, mesh):
+    """Device-resident spec windows over the slice cache: dispatches
+    broadcast as OP_SPECW ops (first with an explicit drafting context,
+    then riding the per-process device carry); tokens still equal the
+    contiguous decode, and windows actually ran."""
+    cache = SlicePagedKVCache(
+        CFG, slots=2, pages=40, page_size=4, mesh=mesh,
+        max_pages_per_seq=-(-(CFG.max_seq + 3) // 4),
+    )
+    server = PagedGenerationServer(params, CFG, cache=cache,
+                                   speculative=3, spec_window=4)
+    try:
+        prompt = [5, 9, 2, 5, 9, 2, 5, 9]
+        assert server.submit(prompt, n_new=12) == reference(
+            params, prompt, 12
+        )
+        stats = server.stats()
+        assert stats["spec_windows_total"] >= 1
+        assert stats["spec_window_emitted_tokens"]["count"] >= 1
+    finally:
+        server.close()
+
+
 def test_slice_server_prefix_sharing_stays_exact(params, mesh):
     """The prefix registry (host-only leader state) composes with the
     slice cache: a repeated prompt reuses pinned pages and still decodes

@@ -41,6 +41,11 @@ Usage::
                                          # tests (-m trace: flight
                                          # recorder, Chrome export,
                                          # bit-identity); fast, tier-1
+    python tools/run_tests.py --window   # only the device-resident
+                                         # spec-window tests (-m window:
+                                         # windowed-spec bit-identity +
+                                         # the paged kernel's exactness/
+                                         # agreement pins); fast, tier-1
     python tools/run_tests.py --lint     # lock-discipline gate: runs
                                          # tools/locklint.py over the
                                          # package (fast-fails on any
@@ -188,6 +193,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="run only the request-tracing tests "
                          "(forwards -m trace)")
+    ap.add_argument("--window", action="store_true",
+                    help="run only the device-resident spec-window "
+                         "tests (forwards -m window: windowed-spec "
+                         "bit-identity, composition, and the paged "
+                         "kernel exactness pins)")
     ap.add_argument("--lint", action="store_true",
                     help="run the lock-discipline gate: tools/locklint.py "
                          "over kvedge_tpu/, then the analyzer's own tests "
@@ -211,6 +221,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "sched"]
     if args.trace:
         args.pytest_args += ["-m", "trace"]
+    if args.window:
+        args.pytest_args += ["-m", "window"]
     if args.lint:
         # The analyzer gate runs FIRST and fast-fails: a tree with
         # unsuppressed findings should not spend minutes in pytest
