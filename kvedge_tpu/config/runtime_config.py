@@ -290,6 +290,30 @@ class RuntimeConfig:
     serving_slots: int = 4
     serving_page_size: int = 16
     serving_pages: int = 0
+    # HBM byte budget for the page pool ([payload]
+    # serving_hbm_budget_mb, 0 = off): instead of counting pages, the
+    # operator states how many MB of accelerator memory the KV pool may
+    # hold and the pool sizes itself to ``budget // page_bytes`` pages
+    # (page_bytes covers K + V at the storage dtype, plus the fp32
+    # scale slabs when serving_kv_dtype="int8"). Mutually exclusive
+    # with an explicit serving_pages — two sources of truth for one
+    # pool would silently shadow each other.
+    serving_hbm_budget_mb: int = 0
+    # Free-page watermarks (fractions of the pool, 0 = off): below
+    # ``low`` unreserved headroom, non-top-priority admissions shed
+    # with page-capacity terms; a preempted request resumes only while
+    # headroom sits at or above ``high`` (hysteresis against
+    # preempt/resume thrash). low <= high when both are set.
+    serving_page_low_watermark: float = 0.0
+    serving_page_high_watermark: float = 0.0
+    # Bucketed compile cache for the paged backend ([payload]
+    # serving_min_bucket, 0 = off): the device batch dim runs at the
+    # smallest power-of-two bucket (from this floor, capped at
+    # serving_slots) covering the occupied rows, so hundreds of slots
+    # cost compile time only when traffic actually reaches them —
+    # admissions within a bucket never retrace. Single-host paged
+    # backend only (the slice op stream pins shapes at slots).
+    serving_min_bucket: int = 0
     # KV-cache storage dtype for the paged backend: "" = the compute
     # dtype (bf16, bit-exact vs the contiguous backend); "int8" =
     # per-token-row symmetric quantization with fp32 scales — the
@@ -534,6 +558,22 @@ class RuntimeConfig:
                 serving_pages=int(
                     payload_doc.get("serving_pages", cls.serving_pages)
                 ),
+                serving_hbm_budget_mb=int(
+                    payload_doc.get("serving_hbm_budget_mb",
+                                    cls.serving_hbm_budget_mb)
+                ),
+                serving_page_low_watermark=float(
+                    payload_doc.get("serving_page_low_watermark",
+                                    cls.serving_page_low_watermark)
+                ),
+                serving_page_high_watermark=float(
+                    payload_doc.get("serving_page_high_watermark",
+                                    cls.serving_page_high_watermark)
+                ),
+                serving_min_bucket=int(
+                    payload_doc.get("serving_min_bucket",
+                                    cls.serving_min_bucket)
+                ),
                 serving_kv_dtype=str(
                     payload_doc.get("serving_kv_dtype",
                                     cls.serving_kv_dtype)
@@ -686,6 +726,38 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving_pages must be >= 0 (0 = auto-size so "
                 "every slot fits a worst-case request)"
+            )
+        if self.serving_hbm_budget_mb < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_hbm_budget_mb must be >= 0 "
+                "(0 = off; size the pool by serving_pages instead)"
+            )
+        if self.serving_hbm_budget_mb > 0 and self.serving_pages > 0:
+            raise RuntimeConfigError(
+                "[payload] serving_hbm_budget_mb and serving_pages are "
+                "mutually exclusive — two sources of truth for one "
+                "page pool; set one and leave the other 0"
+            )
+        for name in ("serving_page_low_watermark",
+                     "serving_page_high_watermark"):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or not 0.0 <= v < 1.0:
+                raise RuntimeConfigError(
+                    f"[payload] {name} must be a fraction in [0, 1) "
+                    "(0 = off)"
+                )
+        if (self.serving_page_low_watermark
+                and self.serving_page_high_watermark
+                and self.serving_page_low_watermark
+                > self.serving_page_high_watermark):
+            raise RuntimeConfigError(
+                "[payload] serving_page_low_watermark must be <= "
+                "serving_page_high_watermark"
+            )
+        if self.serving_min_bucket < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_min_bucket must be >= 0 (0 = off: "
+                "the device batch dim is pinned to serving_slots)"
             )
         if self.serving_kv_dtype not in ("", "int8"):
             raise RuntimeConfigError(
@@ -857,6 +929,12 @@ class RuntimeConfig:
             f"serving_slots = {self.serving_slots}\n"
             f"serving_page_size = {self.serving_page_size}\n"
             f"serving_pages = {self.serving_pages}\n"
+            f"serving_hbm_budget_mb = {self.serving_hbm_budget_mb}\n"
+            "serving_page_low_watermark = "
+            f"{self.serving_page_low_watermark}\n"
+            "serving_page_high_watermark = "
+            f"{self.serving_page_high_watermark}\n"
+            f"serving_min_bucket = {self.serving_min_bucket}\n"
             f"serving_kv_dtype = {s(self.serving_kv_dtype)}\n"
             f"serving_prefill_chunk = {self.serving_prefill_chunk}\n"
             "serving_prefix_cache = "

@@ -147,7 +147,7 @@ class PagedKVCache:
 
     def __init__(self, cfg: TransformerConfig, *, slots: int, pages: int,
                  page_size: int = 16, max_pages_per_seq: int | None = None,
-                 kv_dtype: str = ""):
+                 kv_dtype: str = "", min_bucket: int = 0):
         from kvedge_tpu.models.moe import warn_if_train_serve_divergence
 
         cfg.validate()
@@ -161,6 +161,22 @@ class PagedKVCache:
         self.slots = slots
         self.num_pages = pages
         self.page_size = page_size
+        # Bucketed compile cache (capacity scaling): host bookkeeping is
+        # always ``slots``-sized, but the DEVICE batch dim (tables,
+        # lengths — the only arrays that carry it; the page pool is
+        # slot-count-independent) is ``self.bucket``: a power of two
+        # from ``min_bucket`` up, capped at ``slots``. jit keys on array
+        # shapes, so every program compiles once per bucket and
+        # admissions within a bucket ride the dead-row masks with zero
+        # retraces; :meth:`set_bucket` steps the batch dim at quiescent
+        # points. ``min_bucket=0`` disables bucketing (bucket pinned to
+        # ``slots`` — the pre-bucketing behavior, and REQUIRED for the
+        # slice cache, whose broadcast op stream fixes payload shapes
+        # at ``slots``).
+        if min_bucket < 0:
+            raise ValueError(f"min_bucket must be >= 0, got {min_bucket}")
+        self.min_bucket = min(min_bucket, slots) if min_bucket else 0
+        self.bucket = self.bucket_for(0)
         self.max_pages_per_seq = (
             max_pages_per_seq or -(-cfg.max_seq // page_size)
         )
@@ -240,12 +256,86 @@ class PagedKVCache:
         return PagedState(
             pool_k=jnp.zeros(shape, dtype),
             pool_v=jnp.zeros(shape, dtype),
-            tables=jnp.zeros((self.slots, self.max_pages_per_seq),
+            tables=jnp.zeros((self.bucket, self.max_pages_per_seq),
                              jnp.int32),
-            lengths=jnp.zeros((self.slots,), jnp.int32),
+            lengths=jnp.zeros((self.bucket,), jnp.int32),
             scale_k=scale(),
             scale_v=scale(),
         )
+
+    # ---- bucketed device batch dim --------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """The smallest bucket that holds ``n`` rows: powers of two from
+        ``min_bucket`` up, capped at ``slots`` (the top bucket is
+        ``slots`` itself even when that is not a power of two). With
+        bucketing disabled the only bucket is ``slots``."""
+        if not self.min_bucket:
+            return self.slots
+        b = self.min_bucket
+        while b < n and b < self.slots:
+            b *= 2
+        return min(b, self.slots)
+
+    def quiescent(self) -> bool:
+        """No device-resident carry (greedy or spec) and no unharvested
+        spec reservation — the state in which :meth:`set_bucket` is
+        safe AND free: nothing in flight references the old batch
+        shape."""
+        return (self._carry is None and self._spec_carry is None
+                and not any(self._spec_unharvested))
+
+    def spec_pending(self) -> bool:
+        """Any dispatched-but-unharvested spec reservation? The ONE
+        hard blocker for :meth:`set_bucket` (device lengths are
+        data-dependent until harvest); mere carries are droppable at a
+        pipeline boundary, where the next dispatch re-feeds host
+        tokens."""
+        return any(self._spec_unharvested)
+
+    def rows_in_use(self) -> int:
+        """1 + the highest admitted slot (0 when empty): the smallest
+        device batch dim that still covers every live row — what the
+        serving layer's bucket step-down must not shrink below."""
+        return max(self._pages_of, default=-1) + 1
+
+    def set_bucket(self, n: int) -> None:
+        """Resize the DEVICE batch dim to bucket ``n`` (a quiescent-point
+        operation: no window/spec carry may be in flight — the serving
+        loop collapses its pipeline to a boundary first). The page pool
+        never moves; only tables/lengths rebuild from the host mirrors,
+        so the resize is a host->device upload of two small arrays and
+        the next program traces once for the new shape. Any device
+        carry is dropped (the pipeline restarts from host tokens, which
+        the overlap path already proves bit-identical)."""
+        if n == self.bucket:
+            return
+        if not self.min_bucket:
+            raise PagedCacheError(
+                "bucketing is disabled on this cache (min_bucket=0); "
+                "the device batch dim is pinned to slots"
+            )
+        if n != self.bucket_for(n) or n < self.min_bucket or n > self.slots:
+            raise PagedCacheError(
+                f"bucket {n} is not on this cache's ladder "
+                f"(powers of two from {self.min_bucket} capped at "
+                f"{self.slots})"
+            )
+        if any(self._spec_unharvested):
+            raise PagedCacheError(
+                "cannot resize the device batch dim with spec windows "
+                "in flight — harvest them first (device lengths are "
+                "data-dependent until then)"
+            )
+        top = max(self._pages_of, default=-1)
+        if top >= n:
+            raise PagedCacheError(
+                f"slot {top} is admitted but bucket {n} holds rows "
+                f"0..{n - 1} — release or migrate it first"
+            )
+        self.drop_carry()
+        self.bucket = n
+        self._sync()
 
     # ---- control plane (host) -------------------------------------------
 
@@ -297,6 +387,11 @@ class PagedKVCache:
         """
         if slot in self._pages_of:
             raise PagedCacheError(f"slot {slot} already admitted")
+        if slot >= self.bucket:
+            raise PagedCacheError(
+                f"slot {slot} is outside the current device bucket "
+                f"({self.bucket} rows) — step the bucket up first"
+            )
         total = -(-prompt_len // self.page_size) or 1
         needed = total - len(shared_pages)
         if needed < 0:
@@ -380,7 +475,8 @@ class PagedKVCache:
     def _sync(self) -> None:
         import numpy as _np
 
-        lengths = jnp.asarray(self._host_lengths, jnp.int32)
+        b = self.bucket
+        lengths = jnp.asarray(self._host_lengths[:b], jnp.int32)
         if any(self._spec_unharvested):
             # Spec windows in flight advance their slots' DEVICE
             # lengths by data-dependent acceptance counts the host
@@ -388,12 +484,12 @@ class PagedKVCache:
             # admit/grow/release must keep those slots' device lengths,
             # not clobber them with the stale host mirror.
             mask = jnp.asarray(
-                _np.asarray(self._spec_unharvested) > 0
+                _np.asarray(self._spec_unharvested[:b]) > 0
             )
             lengths = jnp.where(mask, self.state.lengths, lengths)
         self.state = dataclasses.replace(
             self.state,
-            tables=jnp.asarray(self._host_tables, jnp.int32),
+            tables=jnp.asarray(self._host_tables[:b], jnp.int32),
             lengths=lengths,
         )
 
@@ -711,7 +807,7 @@ class PagedKVCache:
         import numpy as _np
 
         if steps_left is None:
-            return _np.full((self.slots,), n_steps, _np.int32)
+            return _np.full((self.bucket,), n_steps, _np.int32)
         caps = _np.minimum(
             _np.asarray(steps_left, _np.int64), n_steps
         )
@@ -1016,7 +1112,7 @@ class PagedKVCache:
         K+1], counts [n_passes, slots], pending [slots])`` as numpy."""
         emitted, counts, pending = self._force_spec_window(handle)
         caps = handle["caps"]
-        for slot in range(self.slots):
+        for slot in range(len(caps)):
             # A slot released (or released and re-admitted) while its
             # window was in flight already had its bookkeeping zeroed —
             # release()/drop_carry() are authoritative; settling here
@@ -1029,6 +1125,24 @@ class PagedKVCache:
 
 
 # ---- jitted kernels ------------------------------------------------------
+
+# Retrace telemetry: each impl body notes a trace event when Python
+# actually runs it — which under jit happens ONLY at trace time (a jit
+# cache hit replays the compiled program without touching the Python
+# body). The capacity tests pin "admissions within a bucket cause zero
+# recompiles" on the delta of this counter, and it covers the slice
+# path too (runtime/sliceserve.py re-jits these same impl functions).
+_TRACE_EVENTS: dict = {"total": 0}
+
+
+def trace_count() -> int:
+    """Total paged-program trace events since import (monotonic)."""
+    return _TRACE_EVENTS["total"]
+
+
+def _note_trace(name: str) -> None:
+    _TRACE_EVENTS["total"] += 1
+    _TRACE_EVENTS[name] = _TRACE_EVENTS.get(name, 0) + 1
 
 
 def _gather_pages_impl(state: PagedState, idx):
@@ -1285,6 +1399,7 @@ def _paged_prefill_impl(params: dict, state: PagedState, prompt, slot,
     # ``slot`` and ``offset`` are traced (they are only ever indices),
     # so XLA compiles one program per CHUNK length, not one per
     # (slot, offset, length) triple.
+    _note_trace("prefill")
     dtype = jnp.dtype(cfg.dtype)
     x = params["embedding"][prompt][None].astype(dtype)  # [1, T, D]
     q_positions = (offset + jnp.arange(prompt.shape[0]))[None]
@@ -1308,6 +1423,7 @@ def _decode_step_core(params: dict, state: PagedState, tokens,
     lengths>0 is NOT sufficient once chunked prefill exists (a
     half-prefilled slot is admitted with its final length but must not
     be touched by decode)."""
+    _note_trace("decode_step")
     dtype = jnp.dtype(cfg.dtype)
     x = params["embedding"][tokens][:, None].astype(dtype)  # [B, 1, D]
     q_positions = state.lengths[:, None]  # [B, 1]
@@ -1358,6 +1474,7 @@ def _spec_verify_core(params: dict, state: PagedState, tokens,
     accepted drafts'; the bonus token's K/V is the next pass's pending
     write, exactly like plain decode.
     """
+    _note_trace("spec_verify")
     dtype = jnp.dtype(cfg.dtype)
     k_len = tokens.shape[1] - 1
     x = params["embedding"][tokens].astype(dtype)  # [B, 1+K, D]
@@ -1442,6 +1559,7 @@ def _paged_spec_window_impl(params: dict, state: PagedState, tokens,
     """
     from kvedge_tpu.models.speculative import _propose_ngram
 
+    _note_trace("spec_window")
     s_ctx = ctx.shape[1]
 
     def body(carry, _):
@@ -1496,6 +1614,8 @@ def _paged_decode_window_impl(params: dict, state: PagedState, tokens,
     token and emits its greedy successor. Inactive slots produce garbage
     tokens that are never read (their scatters drop, their lengths hold).
     """
+    _note_trace("window")
+
     def body(carry, _):
         state, toks = carry
         logits, state = _decode_step_core(params, state, toks, cfg, active)
@@ -1531,6 +1651,8 @@ def _paged_decode_window_capped_impl(params: dict, state: PagedState,
     truncates its stream at the true stop when it harvests
     (row b's real tokens are produced[:steps_left[b]]).
     """
+    _note_trace("window_capped")
+
     def body(carry, i):
         state, toks = carry
         live = active & (i < steps_left)
@@ -1560,6 +1682,7 @@ def _paged_decode_window_sampled_capped_impl(
     base + i)``), so pipelined and serial sampled decode emit identical
     tokens; frozen rows' draws are computed and discarded (their
     outputs are never read and their state never advances)."""
+    _note_trace("window_sampled_capped")
     keys = jax.random.wrap_key_data(key_data)
 
     def body(carry, i):
@@ -1611,6 +1734,7 @@ def _paged_decode_window_sampled_impl(params: dict, state: PagedState,
     on device — raw data crosses process boundaries (the slice
     op-stream) where typed key arrays cannot.
     """
+    _note_trace("window_sampled")
     keys = jax.random.wrap_key_data(key_data)
 
     def body(carry, i):

@@ -25,6 +25,7 @@ capability the repo's own README listed as future work.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import queue
 import threading
 import time
@@ -222,6 +223,9 @@ class PagedGenerationServer:
                  sched_max_queue_depth: int = 0,
                  sched_max_queue_wait_s: float = 0.0,
                  sched_swap_budget_mb: int = 0,
+                 min_bucket: int = 0,
+                 page_low_watermark: float = 0.0,
+                 page_high_watermark: float = 0.0,
                  tracer=None, debug_locks: bool = False):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
@@ -351,8 +355,33 @@ class PagedGenerationServer:
             cfg, slots=slots, pages=pages, page_size=page_size,
             max_pages_per_seq=-(-(cfg.max_seq + self._spec)
                                 // page_size),
-            kv_dtype=kv_dtype,
+            kv_dtype=kv_dtype, min_bucket=min_bucket,
         )
+        # Bucketed compile cache (SERVING.md rung 21): the device batch
+        # dim is the cache's current BUCKET, not ``slots`` — every
+        # dispatch-array site below sizes on ``self._cache.bucket``.
+        # An injected cache governs its own bucketing (the slice cache
+        # pins bucket == slots: the broadcast op stream fixes payload
+        # shapes). A pending step-up requested by an admission that
+        # found no row inside the current bucket; the decode loop
+        # applies it at the next pipeline boundary.
+        self._bucket_step_wanted = False
+        # Free-page watermarks (fractions of the pool, 0 = off): below
+        # ``low`` free-page headroom, non-top-priority admissions shed
+        # with page-capacity terms instead of parking; swapped requests
+        # resume only at ``high`` or better — the hysteresis that stops
+        # preempt/resume thrash when the pool hovers at the edge.
+        for name, v in (("page_low_watermark", page_low_watermark),
+                        ("page_high_watermark", page_high_watermark)):
+            if not 0.0 <= v < 1.0:
+                raise ValueError(f"{name} must be in [0, 1), got {v}")
+        if page_low_watermark and page_high_watermark \
+                and page_low_watermark > page_high_watermark:
+            raise ValueError(
+                "page_low_watermark must be <= page_high_watermark"
+            )
+        self._page_low_wm = float(page_low_watermark)
+        self._page_high_wm = float(page_high_watermark)
         # Prefix sharing: completed prompts register their page-aligned
         # prefixes here (key: token tuple -> pinned pages + LRU stamp);
         # a later prompt with the same prefix starts its table on those
@@ -431,7 +460,10 @@ class PagedGenerationServer:
         # does.
         self._swap_page_bytes: int | None = None
         self._active: dict[int, _Request] = {}
-        self._free_slots = list(range(slots))[::-1]
+        # Min-heap: allocation always takes the LOWEST free slot, so the
+        # occupied set stays dense at the bottom of the batch dim — the
+        # property that lets the bucket step back down when load drops.
+        self._free_slots = list(range(slots))
         self._closed = False
         self._draining = False
         # Degraded mode (runtime/failures.py): a decode-loop failure
@@ -659,12 +691,22 @@ class PagedGenerationServer:
             # recovery machinery's hint).
             shed = self._sched.shed_check_locked(priority, deadline_ms,
                                                  rid=request_id)
+            if shed is None:
+                # Page-watermark shed (capacity semantics, SERVING.md
+                # rung 21): when granting this request's worst-case
+                # reservation would push free-page headroom below the
+                # low watermark, non-top-priority arrivals shed with
+                # page terms instead of parking behind a pool that
+                # cannot absorb them. The top class always parks — it
+                # is what the preemption path frees pages FOR.
+                shed = self._page_shed_locked(priority, pages_needed)
             if shed is not None:
                 hint = shed["retry_after_s"]
                 if hint is None:
                     hint = self._retry_hint()
                 raise ServerOverloaded(
-                    f"request shed: {shed['reason']}; queue depth "
+                    f"request shed: {shed['reason']}; "
+                    f"{self._capacity_text_locked()}; queue depth "
                     f"[{self._sched.depth_text_locked()}]"
                     + (f"; retry after ~{hint:.1f}s" if hint is not None
                        else ""),
@@ -700,15 +742,17 @@ class PagedGenerationServer:
                     if (self._sched.head_locked() is ticket
                             and self._free_slots
                             and self._reserved + pages_needed
-                            <= self._pages_total):
+                            <= self._pages_total
+                            and self._ensure_bucket_locked()):
                         break
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         hint = self._retry_hint()
                         raise ServerBusy(
-                            "no slot/page capacity within the "
-                            f"timeout ({len(self._active)} requests "
-                            f"in flight; queue depth "
+                            "no page capacity within the timeout "
+                            f"({len(self._active)} requests in "
+                            f"flight; {self._capacity_text_locked()}; "
+                            f"queue depth "
                             f"[{self._sched.depth_text_locked()}]"
                             + (f"; retry after ~{hint:.1f}s"
                                if hint is not None else "") + ")"
@@ -724,7 +768,7 @@ class PagedGenerationServer:
             self._hist_queue.observe(
                 (req.t_admit - req.t_submit) * 1e3
             )
-            slot = self._free_slots.pop()
+            slot = heapq.heappop(self._free_slots)
             self._reserved += pages_needed
             # Prefix sharing: start the table on the cached prefix's
             # read-only pages and evict LRU registry pins (never the
@@ -817,6 +861,91 @@ class PagedGenerationServer:
                     self._poison_locked(e)
             raise
         return req
+
+    # ---- capacity semantics (SERVING.md rung 21) ------------------------
+
+    def _capacity_text_locked(self) -> str:
+        """Page-capacity terms for refusal payloads: pages, not slots,
+        gate admission now, so a refused caller learns the pool state
+        it is actually queued behind."""
+        free = self._pages_total - self._reserved
+        return (f"{free}/{self._pages_total} pages unreserved, "
+                f"bucket {self._cache.bucket}/{self._cache.slots} rows")
+
+    def _page_shed_locked(self, priority: str,
+                          pages_needed: int) -> dict | None:
+        """Low-watermark page shed: None (park) or a shed record in the
+        scheduler's shape. Top-priority arrivals never page-shed —
+        preemption exists to free pages for exactly them."""
+        if not self._page_low_wm or self._sched.rank(priority) == 0:
+            return None
+        free_after = self._pages_total - self._reserved - pages_needed
+        if free_after >= self._page_low_wm * self._pages_total:
+            return None
+        self._sched.shed += 1
+        return {
+            "reason": (
+                f"free-page headroom below the low watermark "
+                f"({free_after} of {self._pages_total} pages would "
+                f"stay unreserved, watermark {self._page_low_wm:.0%})"
+            ),
+            "retry_after_s": None,
+        }
+
+    def _resume_pages_ok_locked(self, pages_needed: int) -> bool:
+        """High-watermark resume gate: a preempted request swaps back
+        in only when doing so leaves free-page headroom at or above
+        the HIGH watermark — the hysteresis that stops a pool hovering
+        at the low watermark from thrashing preempt/resume cycles."""
+        if not self._page_high_wm:
+            return True
+        free_after = self._pages_total - self._reserved - pages_needed
+        return free_after >= self._page_high_wm * self._pages_total
+
+    def _ensure_bucket_locked(self) -> bool:
+        """Admission's bucket clause: True iff a free slot INSIDE the
+        current device bucket exists. When every free slot lies above
+        the bucket, resize directly if the cache is quiescent (serial
+        loop, or an idle pipeline); otherwise flag the step-up for the
+        decode loop's next boundary and keep the caller parked — it is
+        woken when the resize lands."""
+        if self._free_slots and self._free_slots[0] < self._cache.bucket:
+            return True
+        if not self._free_slots:
+            return False
+        # With nothing dispatched-unharvested the resize is safe here:
+        # the loop's next dispatch at a boundary is always first=True
+        # (host tokens), so the carry set_bucket drops was dead anyway.
+        if self._inflight is None and not self._cache.spec_pending():
+            self._cache.set_bucket(
+                self._cache.bucket_for(self._free_slots[0] + 1)
+            )
+            return True
+        self._bucket_step_wanted = True
+        self._work.notify_all()
+        return False
+
+    def _maybe_step_bucket_locked(self) -> None:
+        """Resize the device batch dim at a pipeline boundary: step UP
+        when an admission parked on a row above the bucket
+        (``_bucket_step_wanted``), step DOWN when the occupied set has
+        drained out of the bucket's top half and nothing is queued.
+        Quiescent points only; no-op with bucketing disabled."""
+        if not self._cache.min_bucket or self._inflight is not None:
+            return
+        if self._cache.spec_pending():
+            return
+        bucket = self._cache.bucket
+        want = self._cache.rows_in_use()
+        if self._bucket_step_wanted and self._free_slots:
+            want = max(want, self._free_slots[0] + 1)
+        self._bucket_step_wanted = False
+        target = self._cache.bucket_for(want)
+        if target > bucket or (target < bucket
+                               and self._sched.head_locked() is None):
+            self._cache.set_bucket(target)
+            self._sched.wake_head_locked()
+            self._work.notify_all()
 
     def _poison_locked(self, failure: ServingFailure) -> None:
         """Poison the pool (lock held): every in-flight waiter gets the
@@ -1233,9 +1362,10 @@ class PagedGenerationServer:
         import numpy as _np
 
         k = self._spec
-        probe_tokens = _np.zeros((self._cache.slots, 1 + k), _np.int32)
-        step_tokens = _np.zeros((self._cache.slots,), _np.int32)
-        active = _np.zeros((self._cache.slots,), bool)
+        n = self._cache.bucket
+        probe_tokens = _np.zeros((n, 1 + k), _np.int32)
+        step_tokens = _np.zeros((n,), _np.int32)
+        active = _np.zeros((n,), bool)
         active[0] = True
         spec_mask = active.copy()
         # The probed window must fit the model (positions 1..1+w) and
@@ -1407,8 +1537,9 @@ class PagedGenerationServer:
             for slot in range(self._cache.slots):
                 if self._cache.is_admitted(slot):
                     self._cache.release(slot)
-            self._free_slots = list(range(self._cache.slots))[::-1]
+            self._free_slots = list(range(self._cache.slots))
             self._reserved = 0
+            self._bucket_step_wanted = False
             self._active.clear()
             # The failing loop drained its in-flight window before
             # poisoning; clear defensively and forget the device
@@ -1416,6 +1547,10 @@ class PagedGenerationServer:
             # (a slice cache's reform() already dropped its own).
             self._inflight = None
             self._cache.drop_carry()
+            if self._cache.min_bucket:
+                # An empty pool restarts at the smallest bucket — the
+                # revived loop retraces nothing until load returns.
+                self._cache.set_bucket(self._cache.bucket_for(0))
             # Scheduler scrub: swapped-out requests were already failed
             # by _poison_locked (their snapshots freed); straggler
             # tickets were woken into the refusal path. The queues
@@ -1444,6 +1579,16 @@ class PagedGenerationServer:
                 "free_slots": len(self._free_slots),
                 "free_pages": self._cache.free_pages(),
                 "reserved_pages": self._reserved,
+                # Capacity semantics (SERVING.md rung 21): the page
+                # pool is the admission resource and the bucket is the
+                # device batch dim — the gauges an operator needs to
+                # see shed/preempt pressure coming.
+                "pages_total": self._pages_total,
+                "slots_total": self._cache.slots,
+                "bucket": self._cache.bucket,
+                "bucket_min": self._cache.min_bucket,
+                "page_low_watermark": self._page_low_wm,
+                "page_high_watermark": self._page_high_wm,
                 "window": self._window,
                 "kv_dtype": ("int8" if self._cache.kv_quantized
                              else str(self._cfg.dtype)),
@@ -1507,7 +1652,7 @@ class PagedGenerationServer:
         """Return a slot + its reservation to the pool (lock held)."""
         if self._cache.is_admitted(slot):
             self._cache.release(slot)
-        self._free_slots.append(slot)
+        heapq.heappush(self._free_slots, slot)
         self._reserved -= pages_needed
         # Targeted admission wakeup: the policy head (and ONLY the
         # head) re-checks capacity; the work condition still fans out
@@ -1581,7 +1726,7 @@ class PagedGenerationServer:
         identical schedule semantics to the per-step path, so the
         key-schedule exactness holds unchanged."""
         k = self._spec
-        n = self._cache.slots
+        n = self._cache.bucket
         tokens = np.zeros((n, k + 1), np.int32)
         mask = np.zeros((n,), bool)
         spec_mask = np.zeros((n,), bool)
@@ -1652,7 +1797,7 @@ class PagedGenerationServer:
         tokens are identical), temperature/top-p, and the sampled-row
         mask. Greedy rows get neutral values (temp 1, top_p 1, zero
         key) that the kernel's per-row select never reads."""
-        n = self._cache.slots
+        n = self._cache.bucket
         key_data = np.zeros((n,) + self._key_data_shape(samplers),
                             np.uint32)
         base_steps = np.zeros((n,), np.int32)
@@ -1852,12 +1997,27 @@ class PagedGenerationServer:
             if (head is None or not head.resume
                     or not self._free_slots
                     or self._reserved + head.pages_needed
-                    > self._pages_total):
+                    > self._pages_total
+                    or not self._resume_pages_ok_locked(
+                        head.pages_needed)):
                 return
+            if self._free_slots[0] >= self._cache.bucket:
+                # The resume row lies above the device bucket: step up
+                # now if nothing is in flight, else at the next
+                # boundary (this method only runs at boundaries, so
+                # the flag lands one iteration later at worst).
+                if (self._inflight is None
+                        and not self._cache.spec_pending()):
+                    self._cache.set_bucket(
+                        self._cache.bucket_for(self._free_slots[0] + 1)
+                    )
+                else:
+                    self._bucket_step_wanted = True
+                    return
             arrays = head.arrays
             self._sched.pop_resume_locked(head)
             req = head.req
-            slot = self._free_slots.pop()
+            slot = heapq.heappop(self._free_slots)
             self._reserved += head.pages_needed
             # Active BEFORE the device calls: if the swap-in faults,
             # the poison path owns this waiter like any other.
@@ -1955,6 +2115,7 @@ class PagedGenerationServer:
                 # freed capacity, then preempt for a starved head.
                 self._maybe_resume_locked()
                 self._maybe_preempt_locked()
+                self._maybe_step_bucket_locked()
                 if not self._active:
                     return "ran"
                 if (self._spec > 0
@@ -1971,8 +2132,8 @@ class PagedGenerationServer:
                 # The explicit mask (not "every admitted slot") is
                 # what keeps interleaved chunked prefills safe: a
                 # half-prefilled slot is admitted but NOT active.
-                tokens = np.zeros((self._cache.slots,), np.int32)
-                mask = np.zeros((self._cache.slots,), bool)
+                tokens = np.zeros((self._cache.bucket,), np.int32)
+                mask = np.zeros((self._cache.bucket,), bool)
                 for slot, req in self._active.items():
                     tokens[slot] = req.next_token
                     mask[slot] = True
@@ -2106,6 +2267,7 @@ class PagedGenerationServer:
                     # quiescent.
                     self._maybe_resume_locked()
                     self._maybe_preempt_locked()
+                    self._maybe_step_bucket_locked()
                     if not self._active:
                         return "ran"
                     if (self._spec > 0
@@ -2194,12 +2356,14 @@ class PagedGenerationServer:
         of a slot that sat out the previous window is garbage). The
         scheduler adds a third reason: a resumable or starved-but-
         preemptable head collapses the pipeline to a boundary, where
-        the swap may join."""
+        the swap may join. A pending bucket step is a fourth: the
+        device batch dim can only resize with nothing in flight."""
         dispatched = {slot for slot, _, _ in prev["parts"]}
         for slot, req in self._active.items():
             if req.cancelled or slot not in dispatched:
                 return True
-        return self._sched_attention_locked(ignore_inflight=True)
+        return (self._bucket_step_wanted
+                or self._sched_attention_locked(ignore_inflight=True))
 
     def _fail_swapped_closed_locked(self) -> None:
         """Hard close reaches the swap set like the active set: a
@@ -2243,7 +2407,7 @@ class PagedGenerationServer:
         w = min(self._window, max(cap for _, _, cap in parts))
         if w > 1:
             w = 1 << (w.bit_length() - 1)
-        n = self._cache.slots
+        n = self._cache.bucket
         tokens = np.zeros((n,), np.int32)
         mask = np.zeros((n,), bool)
         steps_left = np.zeros((n,), np.int32)
@@ -2358,7 +2522,7 @@ class PagedGenerationServer:
         """
         k = self._spec
         w = self._spec_window
-        n = self._cache.slots
+        n = self._cache.bucket
         budgets = np.zeros((n,), np.int32)
         parts = []
         for slot, req in self._active.items():

@@ -88,6 +88,27 @@ _SERVE_METRIC_FIELDS = (
      "unreferenced KV pages in the pool (paged backend)"),
     ("reserved_pages", "serve_reserved_pages", "gauge",
      "worst-case pages reserved by in-flight requests (paged backend)"),
+    # Capacity semantics (SERVING.md rung 21): total pool size, the
+    # compile bucket the device batch dim currently runs at, and the
+    # free-page watermarks the scheduler's shed/resume decisions key on.
+    ("pages_total", "serve_pages_total", "gauge",
+     "total KV pages in the pool (paged backend; HBM-budget- or "
+     "serving_pages-sized)"),
+    ("slots_total", "serve_slots_total", "gauge",
+     "configured decode slots — the bucket ladder's ceiling (paged "
+     "backend)"),
+    ("bucket", "serve_bucket", "gauge",
+     "device batch rows currently compiled for — the active compile "
+     "bucket (paged backend; equals slots when bucketing is off)"),
+    ("bucket_min", "serve_bucket_min", "gauge",
+     "smallest compile bucket (serving_min_bucket; 0 = bucketing off, "
+     "batch dim pinned to slots)"),
+    ("page_low_watermark", "serve_page_low_watermark", "gauge",
+     "free-page fraction below which non-top-priority admissions shed "
+     "(0 = off)"),
+    ("page_high_watermark", "serve_page_high_watermark", "gauge",
+     "free-page fraction swapped requests wait for before resuming "
+     "(0 = off)"),
     ("prefix_entries", "serve_prefix_entries", "gauge",
      "registered prefix-cache entries (paged backend)"),
     ("prefix_hits", "serve_prefix_hits_total", "counter",

@@ -714,7 +714,7 @@ def _run_multihost_serve(cfg: RuntimeConfig, base, tcfg, mesh):
         )
     leader = jax.process_index() == 0
     replicated = NamedSharding(mesh, P())
-    max_rows = 4 * cfg.serving_slots
+    max_rows = _serve_max_rows(cfg, tcfg)
 
     def bcast(tree):
         return multihost_utils.broadcast_one_to_all(tree)
@@ -894,6 +894,25 @@ def _spec_draft_len(cfg) -> int:
     return cfg.serving_speculative
 
 
+def _serving_page_bytes(cfg, tcfg) -> int:
+    """HBM bytes ONE pool page costs: K and V slabs across every layer
+    (``[n_layers, page_size, kv_heads, d_head]`` each), plus the two
+    fp32 scale slabs an int8 pool carries alongside (kvcache.PagedState
+    docstring). This mirrors ``PagedKVCache.__init__``'s allocation
+    exactly — the budget arithmetic and the arrays it pays for must
+    never drift apart."""
+    import jax.numpy as jnp
+
+    page_size = cfg.serving_page_size
+    itemsize = (1 if cfg.serving_kv_dtype == "int8"
+                else jnp.dtype(tcfg.dtype).itemsize)
+    row = tcfg.n_layers * page_size * tcfg.kv_heads
+    per_page = row * tcfg.d_head * itemsize * 2  # K + V
+    if cfg.serving_kv_dtype == "int8":
+        per_page += row * 4 * 2  # fp32 scale_k + scale_v
+    return per_page
+
+
 def _serving_pool_dims(cfg, tcfg) -> tuple[int, int, int, int]:
     """``(slots, pages, page_size, max_pages_per_seq)`` of the paged
     pool — ONE derivation for the single-host server and the slice
@@ -901,11 +920,42 @@ def _serving_pool_dims(cfg, tcfg) -> tuple[int, int, int, int]:
     auto-sizes so every slot can hold a worst-case request — admission
     then only ever waits on slots, never on pages. Speculative mode
     widens both by the draft slack (a verify pass writes K positions
-    past a GREEDY request's budget even when nothing accepts)."""
+    past a GREEDY request's budget even when nothing accepts).
+
+    ``serving_hbm_budget_mb`` sizes the pool from a BYTE budget instead
+    (mutually exclusive with ``serving_pages`` — config validation
+    enforces it): pages = budget // page_bytes, floored. Admission then
+    gates on pages, not slots (SERVING.md rung 21), so a budget smaller
+    than ``slots`` worst-case requests is a deliberate oversubscription,
+    not an error — but a budget too small for even ONE worst-case
+    request can never admit anything and fails loudly here."""
     slots, page_size = cfg.serving_slots, cfg.serving_page_size
     mpps = -(-(tcfg.max_seq + _spec_draft_len(cfg)) // page_size)
-    pages = cfg.serving_pages or slots * mpps
+    if cfg.serving_hbm_budget_mb:
+        pages = (cfg.serving_hbm_budget_mb * 2**20
+                 ) // _serving_page_bytes(cfg, tcfg)
+        if pages < mpps:
+            raise MeshConfigError(
+                f"serving_hbm_budget_mb = {cfg.serving_hbm_budget_mb} "
+                f"buys {pages} pages, but one worst-case request needs "
+                f"{mpps} (max_seq {tcfg.max_seq} + draft slack at page "
+                f"size {page_size}); raise the budget or shrink max_seq"
+            )
+    else:
+        pages = cfg.serving_pages or slots * mpps
     return slots, pages, page_size, mpps
+
+
+def _serve_max_rows(cfg, tcfg) -> int:
+    """Ingress row ceiling for one ``/generate`` request: 4 waves of
+    the pool's WORST-CASE concurrency — the number of full-length
+    requests the page budget can actually hold at once, capped at the
+    slot count. For auto-sized pools ``pages // mpps == slots``, so
+    this reproduces the old ``4 * serving_slots`` ceiling exactly; a
+    budget-sized pool that holds fewer worst-case residents than slots
+    lowers the ceiling to match what admission can really run."""
+    slots, pages, _, mpps = _serving_pool_dims(cfg, tcfg)
+    return 4 * max(1, min(slots, pages // mpps))
 
 
 def _run_multihost_paged_serve(cfg, base, tcfg, mesh, restored_step,
@@ -1033,8 +1083,8 @@ def _parse_generate_request(doc: dict, tcfg, *, max_rows: int,
         # surface).
         raise ValueError(
             f"request carries {len(tokens)} token rows > the "
-            f"runtime's ceiling of {max_rows} (4 x "
-            "serving_slots); split the request"
+            f"runtime's ceiling of {max_rows} (4 x the page pool's "
+            "worst-case request capacity); split the request"
         )
     try:
         n_new = int(doc.get("n_new", 16))
@@ -1247,8 +1297,10 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
 
     # Row ceiling + worker pool sized from the serving knobs: the
     # serve path must not spawn one thread per row (VERDICT r3 #6 —
-    # a burst of wide requests was an unbounded thread surface).
-    max_rows = 4 * cfg.serving_slots
+    # a burst of wide requests was an unbounded thread surface). The
+    # ceiling is page-budget-derived (SERVING.md rung 21), not a bare
+    # slot multiple — a budget-sized pool admits what pages allow.
+    max_rows = _serve_max_rows(cfg, tcfg)
     # Request-scoped tracing ([payload] serving_trace, SERVING.md rung
     # 18): ONE flight recorder per serving pool, shared by reference
     # with the scheduler, the (slice) cache, the deadline runner and
@@ -1296,6 +1348,16 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 sched_max_queue_wait_s=(
                     cfg.serving_sched_max_queue_wait_s),
                 sched_swap_budget_mb=cfg.serving_sched_swap_budget_mb,
+                # Capacity semantics (SERVING.md rung 21): power-of-two
+                # compile buckets over the device batch dim, and
+                # free-page watermarks feeding the scheduler's shed and
+                # resume decisions. An injected cache (the slice path)
+                # governs its own bucket — it pins to slots, and the
+                # server follows the cache, so min_bucket only reaches
+                # the pool this ctor builds itself.
+                min_bucket=cfg.serving_min_bucket,
+                page_low_watermark=cfg.serving_page_low_watermark,
+                page_high_watermark=cfg.serving_page_high_watermark,
                 # Overlapped window pipeline ([payload]
                 # serving_overlap). Multi-host note: revive() after a
                 # recovery restarts _loop, which re-selects the

@@ -46,6 +46,11 @@ Usage::
                                          # windowed-spec bit-identity +
                                          # the paged kernel's exactness/
                                          # agreement pins); fast, tier-1
+    python tools/run_tests.py --capacity # only the capacity-driven
+                                         # batching tests (-m capacity:
+                                         # bucketed compile cache, HBM
+                                         # page budget, watermark shed/
+                                         # resume); fast, tier-1
     python tools/run_tests.py --lint     # lock-discipline gate: runs
                                          # tools/locklint.py over the
                                          # package (fast-fails on any
@@ -198,6 +203,11 @@ def main(argv: list[str] | None = None) -> int:
                          "tests (forwards -m window: windowed-spec "
                          "bit-identity, composition, and the paged "
                          "kernel exactness pins)")
+    ap.add_argument("--capacity", action="store_true",
+                    help="run only the capacity-driven batching tests "
+                         "(forwards -m capacity: bucketed compile "
+                         "cache, HBM page budget, watermark shed and "
+                         "resume gates)")
     ap.add_argument("--lint", action="store_true",
                     help="run the lock-discipline gate: tools/locklint.py "
                          "over kvedge_tpu/, then the analyzer's own tests "
@@ -223,6 +233,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "trace"]
     if args.window:
         args.pytest_args += ["-m", "window"]
+    if args.capacity:
+        args.pytest_args += ["-m", "capacity"]
     if args.lint:
         # The analyzer gate runs FIRST and fast-fails: a tree with
         # unsuppressed findings should not spend minutes in pytest
