@@ -439,6 +439,22 @@ class RuntimeConfig:
     # only: correct code behaves identically, violations raise
     # LockDisciplineError instead of racing.
     serving_debug_locks: bool = False
+    # Boundary checkpointing for in-flight durability (SERVING.md rung
+    # 22, runtime/journal.py): every N quiescent pipeline boundaries
+    # the decode loop journals each live request's resumable state (KV
+    # pages as verbatim swapout bytes, token log, sampler position,
+    # original ticket) so poison/revive and slice reformation RESUME
+    # in-flight requests bit-identically instead of failing them, and
+    # clients reconnect exactly-once via X-Request-Id +
+    # emitted_offset. 0 (default) = off: today's fail-and-retry poison
+    # semantics, zero overhead. Cost per checkpoint is roughly
+    # pages_live x swap bandwidth; 16 is a reasonable cadence when on.
+    serving_checkpoint_every: int = 0
+    # Page-conservation audit (rung 22's invariant 1): assert
+    # free + live == pages_total at every quiescent boundary, raising
+    # a typed PageAccountingError — loud, attributable leak detection
+    # for debug/test runs (the chaos soak runs with it on).
+    serving_debug_pages: bool = False
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -636,6 +652,13 @@ class RuntimeConfig:
                 ),
                 serving_debug_locks=payload_doc.get(
                     "serving_debug_locks", cls.serving_debug_locks
+                ),
+                serving_checkpoint_every=int(
+                    payload_doc.get("serving_checkpoint_every",
+                                    cls.serving_checkpoint_every)
+                ),
+                serving_debug_pages=payload_doc.get(
+                    "serving_debug_pages", cls.serving_debug_pages
                 ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
@@ -857,6 +880,15 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving_debug_locks must be a boolean"
             )
+        if self.serving_checkpoint_every < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_checkpoint_every must be >= 0 "
+                "(0 = off: no in-flight checkpointing)"
+            )
+        if not isinstance(self.serving_debug_pages, bool):
+            raise RuntimeConfigError(
+                "[payload] serving_debug_pages must be a boolean"
+            )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
                 "[payload] kind = 'train' requires corpus = '<path>' "
@@ -960,6 +992,10 @@ class RuntimeConfig:
             f"{s(self.serving_trace) if isinstance(self.serving_trace, str) else self.serving_trace}\n"
             "serving_debug_locks = "
             f"{'true' if self.serving_debug_locks else 'false'}\n"
+            "serving_checkpoint_every = "
+            f"{self.serving_checkpoint_every}\n"
+            "serving_debug_pages = "
+            f"{'true' if self.serving_debug_pages else 'false'}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

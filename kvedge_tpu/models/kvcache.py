@@ -342,6 +342,29 @@ class PagedKVCache:
     def free_pages(self) -> int:
         return len(self._free)
 
+    def page_accounting(self) -> dict:
+        """Full-pool page census for the conservation audit
+        (``serving_debug_pages`` and the chaos soak's invariant 1).
+        Every page is either on the free list (ref 0) or referenced by
+        some holder — a slot table, a registry pin, or a spec-window
+        pre-allocation, all of which live inside slot page lists and
+        therefore inside ``live``. Conservation holds iff
+        ``free + live == pages_total`` with no duplicate free entries,
+        no negative refcounts, and no page both free and referenced.
+        Pure host bookkeeping: no device work, safe at any boundary."""
+        free_set = set(self._free)
+        return {
+            "free": len(self._free),
+            "live": sum(1 for r in self._refs if r > 0),
+            "pages_total": self.num_pages,
+            "spec_unharvested": sum(self._spec_unharvested),
+            "free_dup": len(self._free) - len(free_set),
+            "neg_refs": sum(1 for r in self._refs if r < 0),
+            "free_live": sum(
+                1 for p in free_set if self._refs[p] > 0
+            ),
+        }
+
     def is_admitted(self, slot: int) -> bool:
         return slot in self._pages_of
 

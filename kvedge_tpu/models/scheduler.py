@@ -466,22 +466,28 @@ class AdmissionScheduler:
 
     def record_swapout_locked(self, req, pclass: str, ticket_no: int,
                               pages_needed: int, saved_len: int,
-                              arrays: tuple) -> _Entry:
+                              arrays: tuple, *,
+                              restore: bool = False) -> _Entry:
         """A victim left the device: park its as-stored page bytes and
         re-queue it under its ORIGINAL ticket number, so it resumes
-        ahead of later arrivals of its class."""
+        ahead of later arrivals of its class. ``restore`` marks a
+        rung-22 journal re-queue (revive found more checkpoints than
+        slots): same parking, but it is not a preemption — the counter
+        and its trace event stay honest."""
         nbytes = sum(a.nbytes for a in arrays)
         e = _Entry(ticket_no, pclass, req, pages_needed, None,
                    time.monotonic(), resume=True, saved_len=saved_len,
                    arrays=arrays, nbytes=nbytes)
         bisect.insort(self._queues[pclass], e, key=lambda x: x.no)
         self.swap_bytes += nbytes
-        self.preemptions += 1
+        if not restore:
+            self.preemptions += 1
         tr = self.tracer
         if tr is not None:
             # Preemptions always record: they reshape every timeline on
             # the pool, not just the victim's.
-            tr.event("swap-out", "sched", rid=getattr(req, "rid", ""),
+            tr.event("journal-requeue" if restore else "swap-out",
+                     "sched", rid=getattr(req, "rid", ""),
                      args={"class": pclass, "ticket": ticket_no,
                            "saved_len": saved_len, "bytes": nbytes})
         return e
@@ -525,14 +531,16 @@ class AdmissionScheduler:
     def take_swapped_locked(self) -> list:
         """Remove and return EVERY resume entry (degraded mode / hard
         close: swapped-out requests fail like active ones — rung 14's
-        contract extends to the swap set). Snapshots are freed."""
+        contract extends to the swap set). The snapshots ride along
+        INTACT: the caller either journals them (rung 22 — the host
+        bytes are already a verbatim checkpoint) or zeroes
+        ``entry.arrays`` to free them."""
         out = []
         for c, q in self._queues.items():
             keep = []
             for e in q:
                 if e.resume:
                     self.swap_bytes -= e.nbytes
-                    e.arrays = ()
                     out.append(e)
                 else:
                     keep.append(e)

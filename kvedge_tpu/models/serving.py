@@ -33,10 +33,12 @@ import time
 import numpy as np
 
 from kvedge_tpu.runtime.failures import (
+    PageAccountingError,
     PoolPoisoned,
     ServingFailure,
     classify_failure,
 )
+from kvedge_tpu.runtime.journal import JournalEntry, RequestJournal
 from kvedge_tpu.models.scheduler import AdmissionScheduler, _Hist
 
 # Stream sentinel objects (token queue carries ints, then one of these).
@@ -91,7 +93,9 @@ class RequestCancelled(RuntimeError):
     """The request was cancelled (consumer disconnect / explicit)."""
 
 
-@dataclasses.dataclass
+# eq=False: a request is its identity (hashable — the journal keys on
+# the live object), never field-equality over mutable token lists.
+@dataclasses.dataclass(eq=False)
 class _Request:
     prompt: list[int]
     n_new: int
@@ -146,6 +150,14 @@ class _Request:
     trace: bool = False
     t_submit: float = 0.0
     t_admit: float = 0.0
+    # Exactly-once delivery watermark (rung 22): tokens at indices
+    # below this were already streamed to the consumer before a
+    # journal restore rewound ``generated`` to the checkpoint —
+    # replayed decode regenerates them bit-identically (greedy argmax
+    # / the positional fold_in key schedule) and ``_emit`` records
+    # them WITHOUT re-streaming. 0 (the normal path) streams every
+    # token.
+    stream_resume_at: int = 0
 
     def pick(self, logits_row, step: int) -> int:
         """Next token from a [V] logits row, greedy or sampled. Used at
@@ -226,7 +238,10 @@ class PagedGenerationServer:
                  min_bucket: int = 0,
                  page_low_watermark: float = 0.0,
                  page_high_watermark: float = 0.0,
-                 tracer=None, debug_locks: bool = False):
+                 tracer=None, debug_locks: bool = False,
+                 checkpoint_every: int = 0,
+                 journal_budget_mb: int = 0,
+                 debug_pages: bool = False):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
@@ -484,6 +499,39 @@ class PagedGenerationServer:
         # the MEASURED recovery time while a heal is in flight.
         self._retry_after_s = retry_after_s
         self.retry_after_hint = None
+        # Boundary checkpointing (runtime/journal.py, SERVING.md rung
+        # 22): every ``checkpoint_every`` pipeline boundaries the loop
+        # journals each live request's resumable state — KV pages as
+        # the verbatim swapout bytes, token log, pending token,
+        # original ticket — so _poison_locked can DIVERT journaled
+        # requests (waiters stay parked) and revive()/reform re-admits
+        # them bit-identically instead of failing them. 0 = off:
+        # today's fail-everything poison semantics, zero cost.
+        if checkpoint_every < 0:
+            raise ValueError("checkpoint_every must be >= 0")
+        if journal_budget_mb < 0:
+            raise ValueError("journal_budget_mb must be >= 0")
+        self._checkpoint_every = int(checkpoint_every)
+        self._journal = RequestJournal(
+            max_bytes=journal_budget_mb * (1 << 20)
+        )
+        # Boundaries-or-harvests since the last checkpoint: a saturated
+        # overlap pipeline rarely visits a boundary on its own, so the
+        # clock also advances per harvested window and an overdue clock
+        # collapses the pipeline (_boundary_wanted_locked) — cadence N
+        # means "at most ~N windows of decode progress ever at risk".
+        self._ckpt_clock = 0
+        self._checkpoints_total = 0
+        self._checkpoint_skipped = 0
+        self._journal_restores = 0
+        # Page-conservation audit ([payload] serving_debug_pages): the
+        # chaos soak's invariant 1, checked at every quiescent boundary
+        # and raised as a typed PageAccountingError on violation.
+        self._debug_pages = bool(debug_pages)
+        # The capacity bucket rung at poison time: revive restores it
+        # (instead of resetting to the bottom rung) so a loaded server
+        # doesn't pay a retrace storm the moment traffic returns.
+        self._prebucket = 0
         # Recorded by start_prefix_persistence so a poisoned-but-
         # readable pool can emergency-dump its warm prefixes on the
         # way down.
@@ -574,12 +622,15 @@ class PagedGenerationServer:
         """
         with self._work:
             req.cancelled = True
-            # Cancel-while-swapped-out: the request holds no slot and
-            # no reservation — only a host snapshot. Free it here (no
-            # decode-loop boundary will ever see this request again)
-            # and fail the waiter.
-            entry = self._sched.drop_swapped_locked(req)
-            if entry is not None:
+            # Cancel-while-swapped-out (or parked in the journal of a
+            # poisoned pool awaiting revive): the request holds no slot
+            # and no reservation — only a host snapshot. Free it here
+            # (no decode-loop boundary will ever see this request
+            # again) and fail the waiter.
+            dropped = self._sched.drop_swapped_locked(req) is not None
+            if not dropped and req not in self._active.values():
+                dropped = self._journal.pop(req) is not None
+            if dropped:
                 req.error = RequestCancelled(
                     "request cancelled while swapped out"
                 )
@@ -947,6 +998,151 @@ class PagedGenerationServer:
             self._sched.wake_head_locked()
             self._work.notify_all()
 
+    # ---- boundary checkpoints + page audit (SERVING.md rung 22) ----------
+
+    def _maybe_checkpoint_locked(self) -> None:
+        """Quiescent-boundary durability hook (lock held, nothing in
+        flight): audit page conservation when asked, then — every
+        ``checkpoint_every`` clock ticks — journal each live request's
+        resumable state. The KV snapshot is the SAME verbatim-bytes
+        gather preemption swaps out (``swapout_pages``, int8 scale
+        slabs included), taken on the live slot without releasing it;
+        ``saved_len`` covers exactly the committed positions, with the
+        pending token stored host-side — the preempt/resume contract,
+        which is why restore is bit-identical for free."""
+        if self._debug_pages:
+            self._audit_pages_locked()
+        if not self._checkpoint_every:
+            return
+        self._ckpt_clock += 1
+        if self._ckpt_clock < self._checkpoint_every:
+            return
+        self._ckpt_clock = 0
+        if not self._active:
+            return
+        t0 = time.perf_counter()
+        for slot, req in self._active.items():
+            if req.cancelled:
+                continue
+            saved_len = len(req.prompt) + len(req.generated)
+            n_pages = -(-saved_len // self._cache.page_size)
+            ids = self._cache.slot_pages(slot)[:n_pages]
+            arrays = self._cache.swapout_pages(ids)
+            entry = JournalEntry(
+                req=req, pclass=req.pclass, ticket_no=req.ticket_no,
+                admit_seq=req.admit_seq,
+                pages_reserved=req.pages_reserved,
+                saved_len=saved_len, gen_len=len(req.generated),
+                next_token=req.next_token,
+                emitted=len(req.generated),
+                arrays=arrays,
+                nbytes=sum(a.nbytes for a in arrays),
+            )
+            if self._journal.put(req, entry):
+                self._checkpoints_total += 1
+            else:
+                # Budget-refused: the request keeps its previous
+                # (older but internally consistent) entry, or stays
+                # unjournaled — counted so operators see the bound
+                # biting.
+                self._checkpoint_skipped += 1
+        if self.tracer is not None:
+            self.tracer.span(
+                "checkpoint", "serve", t0,
+                args={"live": len(self._active),
+                      "entries": len(self._journal),
+                      "bytes": self._journal.nbytes},
+            )
+
+    def _audit_pages_locked(self) -> None:
+        """Assert page conservation at a quiescent boundary (lock
+        held): free + live == pages_total with clean books. Raises the
+        typed :class:`PageAccountingError` — the decode loop's normal
+        failure path poisons the pool with it, so a leak is loud and
+        attributable to the boundary that found it."""
+        acct_fn = getattr(self._cache, "page_accounting", None)
+        if acct_fn is None:  # injected cache without the census
+            return
+        acct = acct_fn()
+        if (acct["free"] + acct["live"] == acct["pages_total"]
+                and not acct["free_dup"] and not acct["neg_refs"]
+                and not acct["free_live"]):
+            return
+        raise PageAccountingError(
+            f"page conservation violated at a quiescent boundary: "
+            f"free={acct['free']} live={acct['live']} "
+            f"total={acct['pages_total']} dup_free={acct['free_dup']} "
+            f"neg_refs={acct['neg_refs']} "
+            f"free_but_live={acct['free_live']}"
+        )
+
+    def _divert_to_journal_locked(self, req: _Request) -> bool:
+        """Poison-path diversion (lock held): True when ``req`` has a
+        checkpoint to resume from — its waiter stays parked across the
+        outage and revive() re-admits it. Records the exactly-once
+        watermark: every token in ``generated`` RIGHT NOW (including
+        post-checkpoint decode) was already delivered, so the replay
+        must not re-stream below this count."""
+        if req.cancelled:
+            return False
+        entry = self._journal.get(req)
+        if entry is None:
+            return False
+        entry.emitted = max(entry.emitted, len(req.generated))
+        return True
+
+    def _journal_swapped_locked(self, entry) -> bool:
+        """Move a swapped-out request's snapshot into the journal at
+        poison time (lock held): the scheduler entry already holds the
+        verbatim host bytes, saved length, and original ticket — a
+        ready-made checkpoint. False (caller fails the request and
+        frees the snapshot) when checkpointing is off, the request was
+        cancelled, or the journal budget refuses the bytes."""
+        if not self._checkpoint_every or entry.req.cancelled:
+            entry.arrays = ()
+            return False
+        req = entry.req
+        je = JournalEntry(
+            req=req, pclass=entry.pclass, ticket_no=entry.no,
+            admit_seq=req.admit_seq,
+            pages_reserved=entry.pages_needed,
+            saved_len=entry.saved_len, gen_len=len(req.generated),
+            next_token=req.next_token, emitted=len(req.generated),
+            arrays=entry.arrays, nbytes=entry.nbytes,
+        )
+        if not self._journal.put(req, je):
+            self._checkpoint_skipped += 1
+            entry.arrays = ()
+            return False
+        self._checkpoints_total += 1
+        return True
+
+    def _fail_journal_locked(self, err: Exception) -> None:
+        """Fail every journaled waiter (lock held) — the close() path
+        of a pool that will never be revived. Without this, diverted
+        requests would park forever behind a teardown."""
+        for entry in self._journal.take_all():
+            req = entry.req
+            if req.done.is_set():
+                continue
+            req.error = err
+            if req.stream is not None:
+                req.stream.put(err)
+            req.done.set()
+
+    def capacity_probe(self) -> dict:
+        """Lock-free capacity snapshot for /healthz: like
+        :attr:`degraded`, bare attribute reads only — a health probe
+        must answer even when a thread is misbehaving around the
+        server lock — so values may be one boundary stale.
+        ``pages_free`` is UNRESERVED pages (the admission resource a
+        load balancer drains on), not the device free list."""
+        return {
+            "pages_free": max(self._pages_total - self._reserved, 0),
+            "pages_total": self._pages_total,
+            "bucket": self._cache.bucket,
+        }
+
     def _poison_locked(self, failure: ServingFailure) -> None:
         """Poison the pool (lock held): every in-flight waiter gets the
         typed failure, the degraded flag flips for stats/healthz, and
@@ -956,15 +1152,22 @@ class PagedGenerationServer:
         if self._poison is None:
             self._poison = failure
             self._degraded_reason = f"{type(failure).__name__}: {failure}"
-        if self.tracer is not None:
-            # The poison instant anchors the flight-recorder tail the
-            # post-mortem (last-failure.json) embeds.
-            self.tracer.event(
-                "poison", "failure",
-                args={"type": type(failure).__name__,
-                      "failed": len(self._active)},
-            )
+            # Satellite of rung 22: remember the capacity rung so
+            # revive restores it instead of resetting to the bottom.
+            self._prebucket = self._cache.bucket
+        # Rung 22 diversion: a request with a journal checkpoint is
+        # NOT failed — its waiter stays parked (done unset, stream
+        # quiet) and revive() re-admits it from the checkpoint,
+        # replaying the post-checkpoint gap bit-identically. Requests
+        # the journal never caught (cadence, budget skip, checkpointing
+        # off) fail exactly as before.
+        survivors = 0
+        failed = 0
         for req in self._active.values():
+            if self._divert_to_journal_locked(req):
+                survivors += 1
+                continue
+            failed += 1
             req.error = failure
             if req.stream is not None:
                 req.stream.put(failure)
@@ -972,13 +1175,28 @@ class PagedGenerationServer:
         self._active.clear()
         # Degraded mode reaches the swap set too (rung 14 x rung 17):
         # a swapped-out request's device pages are gone and no healthy
-        # loop will ever resume it — fail it like an active one and
-        # free its host snapshot.
+        # loop will ever resume it. Its host snapshot is ALREADY a
+        # verbatim checkpoint under the original ticket — with
+        # checkpointing on it moves into the journal; otherwise fail
+        # it like an active one and free the snapshot.
         for entry in self._sched.take_swapped_locked():
+            if self._journal_swapped_locked(entry):
+                survivors += 1
+                continue
+            failed += 1
             entry.req.error = failure
             if entry.req.stream is not None:
                 entry.req.stream.put(failure)
             entry.req.done.set()
+        if self.tracer is not None:
+            # The poison instant anchors the flight-recorder tail the
+            # post-mortem (last-failure.json) embeds.
+            self.tracer.event(
+                "poison", "failure",
+                args={"type": type(failure).__name__,
+                      "failed": failed,
+                      "journaled": survivors},
+            )
         self._closed = True
         self._sched.wake_all_locked()
         self._work.notify_all()
@@ -1457,6 +1675,16 @@ class PagedGenerationServer:
         # skip the release rather than hang close() too. stop() itself
         # is also deadline-bounded, so close() stays bounded even when
         # the followers die between the last op and the STOP broadcast.
+        with self._work:
+            # A closed pool is never revived: journaled survivors of a
+            # poison must not park forever behind a teardown — fail
+            # them with the poison (retryable, hint attached) or plain
+            # ServerClosed.
+            if len(self._journal):
+                self._fail_journal_locked(
+                    self._poison if self._poison is not None
+                    else ServerClosed("server is shut down")
+                )
         stop = getattr(self._cache, "stop", None)
         if stop is not None and not self._thread.is_alive():
             with self._work:
@@ -1495,8 +1723,9 @@ class PagedGenerationServer:
                 print(f"[kvedge-serve] on_degraded observer failed: "
                       f"{e!r}", flush=True)
 
-    def revive(self, *, prefill_wait_s: float = 30.0) -> None:
+    def revive(self, *, prefill_wait_s: float = 30.0) -> int:
         """Warm-restart a poisoned pool in place (recovery supervisor).
+        Returns the number of journaled in-flight requests re-admitted.
 
         Pre-condition: the failed op stream is live again — for a slice
         cache the supervisor runs ``cache.reform()`` FIRST, because the
@@ -1508,9 +1737,15 @@ class PagedGenerationServer:
         pins are evicted (the device K/V behind them is suspect after a
         failure — the emergency dump reloads them from the reusable
         snapshot), every still-admitted slot is released, and the
-        slot/reservation books reset to empty. In-flight requests were
-        already failed by ``_poison_locked``; compiled programs survive
-        untouched — that is the point of reviving over rescheduling.
+        slot/reservation books reset to empty. Unjournaled in-flight
+        requests were already failed by ``_poison_locked``; journaled
+        ones (rung 22) re-admit below into fresh slots — original
+        ticket and class preserved, pages restored verbatim via
+        ``swapin_pages``, decode resumed from the checkpointed offset
+        — transactionally: a re-admission fault re-journals everything
+        (nothing lost) and leaves the pool poisoned for the next
+        attempt. Compiled programs survive untouched — that is the
+        point of reviving over rescheduling.
         """
         # The dying decode thread must be gone before a replacement
         # starts (two loops over one pool would interleave cache calls).
@@ -1548,14 +1783,24 @@ class PagedGenerationServer:
             self._inflight = None
             self._cache.drop_carry()
             if self._cache.min_bucket:
-                # An empty pool restarts at the smallest bucket — the
-                # revived loop retraces nothing until load returns.
-                self._cache.set_bucket(self._cache.bucket_for(0))
-            # Scheduler scrub: swapped-out requests were already failed
-            # by _poison_locked (their snapshots freed); straggler
-            # tickets were woken into the refusal path. The queues
-            # restart empty; cumulative counters survive.
+                # Restore the PRE-POISON rung (floored at what the
+                # journal re-admissions below need) instead of
+                # resetting to the bottom: the compiled programs for
+                # that rung survived, and a loaded server stepping up
+                # from the bottom would pay a retrace storm the moment
+                # traffic returns.
+                rung = self._prebucket or self._cache.bucket_for(0)
+                rung = max(rung,
+                           self._cache.bucket_for(len(self._journal)))
+                self._cache.set_bucket(rung)
+            # Scheduler scrub: unjournaled swapped-out requests were
+            # already failed by _poison_locked (snapshots freed);
+            # straggler tickets were woken into the refusal path. The
+            # queues restart empty; cumulative counters — including
+            # the ticket sequence, so restored tickets stay ordered
+            # ahead of post-revive arrivals — survive.
             self._sched.reset_locked()
+            restored = self._restore_journal_locked()
             self._poison = None
             self._degraded_reason = None
             self._closed = False
@@ -1568,8 +1813,93 @@ class PagedGenerationServer:
                 # Same recorder, same timeline: the revival lands next
                 # to the poison it heals, and the tracer itself needs
                 # no reset (it holds no device or thread state).
-                self.tracer.event("revive", "serve")
+                self.tracer.event("revive", "serve",
+                                  args={"restored": restored})
             self._work.notify_all()
+        return restored
+
+    def _restore_journal_locked(self) -> int:
+        """Re-admit every journaled request into the scrubbed pool
+        (lock held, decode thread not yet started). Each entry rewinds
+        its request to the checkpoint — ``generated`` truncates to the
+        checkpointed length, the pending token and books restore, and
+        the delivered watermark arms ``_emit``'s replay suppression —
+        then takes a fresh slot with the verbatim page bytes swapped
+        back in. The rewind is idempotent, so the failure path can
+        re-journal already-restored entries and retry wholesale."""
+        entries = self._journal.take_all()
+        restored: list[tuple[int, JournalEntry]] = []
+        t0 = time.perf_counter()
+        try:
+            while entries:
+                entry = entries[0]
+                req = entry.req
+                if req.cancelled or req.done.is_set():
+                    entries.pop(0)
+                    continue
+                if not self._free_slots:
+                    # More checkpoints than slots (the poison caught
+                    # swapped-out requests too): the overflow re-queues
+                    # below, after the direct restores commit.
+                    break
+                req.stream_resume_at = max(req.stream_resume_at,
+                                           entry.emitted)
+                del req.generated[entry.gen_len:]
+                req.next_token = entry.next_token
+                req.inflight = 0
+                req.pages_reserved = entry.pages_reserved
+                req.ticket_no = entry.ticket_no
+                req.admit_seq = entry.admit_seq
+                slot = heapq.heappop(self._free_slots)
+                self._reserved += entry.pages_reserved
+                self._active[slot] = req
+                # In ``restored`` BEFORE the device calls: a faulting
+                # admit/swapin must find its slot and reservation in
+                # the unwind below (the entry is then briefly in both
+                # lists — the double re-journal is a same-key replace).
+                restored.append((slot, entry))
+                self._cache.admit(slot, entry.saved_len)
+                self._cache.swapin_pages(
+                    self._cache.slot_pages(slot), entry.arrays
+                )
+                entries.pop(0)
+        except Exception:
+            # Transactional unwind: put everything back — restored
+            # rows included (their rewind is idempotent) — so the next
+            # revive attempt loses nothing.
+            for slot, entry in restored:
+                self._active.pop(slot, None)
+                self._release_locked(slot, entry.pages_reserved)
+            for _, entry in restored:
+                self._journal.put(entry.req, entry)
+            for entry in entries:
+                self._journal.put(entry.req, entry)
+            raise
+        # Slot-overflow checkpoints go back to the SWAP SET under their
+        # original tickets (host bookkeeping only — cannot fault): the
+        # decode loop resumes them at boundaries exactly like preempted
+        # victims, ahead of post-revive arrivals.
+        requeued = 0
+        for entry in entries:
+            req = entry.req
+            req.stream_resume_at = max(req.stream_resume_at,
+                                       entry.emitted)
+            del req.generated[entry.gen_len:]
+            req.next_token = entry.next_token
+            req.inflight = 0
+            self._sched.record_swapout_locked(
+                req, entry.pclass, entry.ticket_no,
+                entry.pages_reserved, entry.saved_len, entry.arrays,
+                restore=True,
+            )
+            requeued += 1
+        self._journal_restores += len(restored) + requeued
+        if self.tracer is not None and (restored or requeued):
+            self.tracer.span(
+                "journal-restore", "serve", t0,
+                args={"restored": len(restored), "requeued": requeued},
+            )
+        return len(restored) + requeued
 
     def stats(self) -> dict:
         with self._lock:
@@ -1610,6 +1940,16 @@ class PagedGenerationServer:
                 "ttft_ms": self._hist_ttft.snapshot(),
                 "queue_ms": self._hist_queue.snapshot(),
                 "decode_ms": self._hist_decode.snapshot(),
+                # Durability semantics (SERVING.md rung 22): journal
+                # occupancy, checkpoint throughput, and the restores
+                # revive() performed — the gauges that prove in-flight
+                # requests are actually covered.
+                "checkpoint_every": self._checkpoint_every,
+                "journal_entries": len(self._journal),
+                "journal_bytes": self._journal.nbytes,
+                "checkpoints_total": self._checkpoints_total,
+                "checkpoint_skipped_total": self._checkpoint_skipped,
+                "journal_restores_total": self._journal_restores,
             }
             if self.tracer is not None:
                 out.update(self.tracer.stats())
@@ -1675,6 +2015,7 @@ class PagedGenerationServer:
                       "class": req.pclass},
             )
         del self._active[slot]
+        self._journal.pop(req)  # a finished request never resumes
         self._release_locked(slot, self._pages_for(req))
         if req.stream is not None:
             req.stream.put(_STREAM_DONE)
@@ -1697,9 +2038,15 @@ class PagedGenerationServer:
 
     @staticmethod
     def _emit(req: _Request, token: int) -> None:
-        """Record a generated token (and stream it when requested)."""
+        """Record a generated token (and stream it when requested).
+        After a journal restore, indices below ``stream_resume_at``
+        are REPLAY — bit-identical regenerations of tokens the
+        consumer already received — recorded but not re-streamed
+        (exactly-once). The normal path's watermark is 0, so this is
+        one dead comparison per token."""
+        idx = len(req.generated)
         req.generated.append(token)
-        if req.stream is not None:
+        if req.stream is not None and idx >= req.stream_resume_at:
             req.stream.put(token)
 
     @staticmethod
@@ -1890,6 +2237,7 @@ class PagedGenerationServer:
             if not req.cancelled:
                 continue
             del self._active[slot]
+            self._journal.pop(req)  # a cancelled request never resumes
             self._release_locked(slot, self._pages_for(req))
             req.error = RequestCancelled(
                 "request cancelled mid-decode"
@@ -2116,6 +2464,7 @@ class PagedGenerationServer:
                 self._maybe_resume_locked()
                 self._maybe_preempt_locked()
                 self._maybe_step_bucket_locked()
+                self._maybe_checkpoint_locked()
                 if not self._active:
                     return "ran"
                 if (self._spec > 0
@@ -2264,10 +2613,13 @@ class PagedGenerationServer:
                     # Preemption/resume join ONLY here — the
                     # non-overlapped boundary, where every row's
                     # tokens are reconciled and cache state is
-                    # quiescent.
+                    # quiescent. Checkpoints share the boundary for
+                    # the same reason: the swapout bytes must cover a
+                    # reconciled, nothing-in-flight snapshot.
                     self._maybe_resume_locked()
                     self._maybe_preempt_locked()
                     self._maybe_step_bucket_locked()
+                    self._maybe_checkpoint_locked()
                     if not self._active:
                         return "ran"
                     if (self._spec > 0
@@ -2362,7 +2714,13 @@ class PagedGenerationServer:
         for slot, req in self._active.items():
             if req.cancelled or slot not in dispatched:
                 return True
+        # A fifth: an overdue checkpoint clock (rung 22). A saturated
+        # pipeline can run windows back-to-back indefinitely; durability
+        # needs a real boundary every ``checkpoint_every`` windows, so
+        # the due clock forces the collapse the checkpoint rides.
         return (self._bucket_step_wanted
+                or (self._checkpoint_every > 0
+                    and self._ckpt_clock >= self._checkpoint_every)
                 or self._sched_attention_locked(ignore_inflight=True))
 
     def _fail_swapped_closed_locked(self) -> None:
@@ -2370,6 +2728,7 @@ class PagedGenerationServer:
         swapped-out request will never be resumed by an exiting loop —
         fail its waiter and free the host snapshot."""
         for entry in self._sched.take_swapped_locked():
+            entry.arrays = ()  # nothing will journal this snapshot
             entry.req.error = ServerClosed(
                 "server shut down mid-request (swapped out)"
             )
@@ -2476,6 +2835,7 @@ class PagedGenerationServer:
             )
         t_host = time.perf_counter()
         rec["counted"] = True
+        self._ckpt_clock += 1  # window of progress at risk (rung 22)
         for _, req, adv in rec["parts"]:
             req.inflight -= adv
         for slot, req, adv in rec["parts"]:
@@ -2582,6 +2942,7 @@ class PagedGenerationServer:
             )
         t_host = time.perf_counter()
         rec["counted"] = True
+        self._ckpt_clock += 1  # window of progress at risk (rung 22)
         for _, req, cap in rec["parts"]:
             req.inflight -= cap
         self._spec_passes += rec["window"]

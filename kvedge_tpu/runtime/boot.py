@@ -270,8 +270,26 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
                 if doc and doc.get("state") == "recovering":
                     out = {"reason": reason, "terminal": False,
                            "recovering": True}
-                    if doc.get("retry_after_s") is not None:
-                        out["retry_after_s"] = doc["retry_after_s"]
+                    # Always a retry hint: the supervisor's measured
+                    # estimate when it has one, else the operator's
+                    # configured reschedule window — a recovering 503
+                    # must never leave the client guessing.
+                    out["retry_after_s"] = (
+                        doc["retry_after_s"]
+                        if doc.get("retry_after_s") is not None
+                        else cfg.serving_retry_after_s
+                    )
+                    # Capacity context (pages_free, pages_total,
+                    # bucket) rides along when the serve path exposes
+                    # its lock-free probe — operators triaging a
+                    # recovery see how much pool the revive must
+                    # rebuild without touching the work lock.
+                    cap = getattr(handle.serve_fn, "capacity", None)
+                    if cap is not None:
+                        try:
+                            out.update(cap())
+                        except Exception:
+                            pass
                     return out
             return {"reason": reason, "terminal": True}
         if not handle.check.ok and handle.check.error:

@@ -101,6 +101,18 @@ class PoolPoisoned(ServingFailure):
         self.retry_after_s = retry_after_s
 
 
+class PageAccountingError(ServingFailure):
+    """The page-conservation audit (``serving_debug_pages`` /
+    testing/chaos.py invariant 1) found the pool's books broken at a
+    quiescent boundary: free + live != pages_total, a negative
+    refcount, or a page both free and referenced. NOT retryable — a
+    leaked or double-freed page is a host-side bookkeeping bug, and a
+    replacement process running the same code will leak the same way;
+    the failure exists to be loud, not survivable."""
+
+    retryable = False
+
+
 def classify_failure(exc: BaseException) -> ServingFailure:
     """The typed error a failed decode loop hands its waiters.
 

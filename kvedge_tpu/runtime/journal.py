@@ -1,0 +1,115 @@
+"""Bounded host-side journal of resumable in-flight request state.
+
+The serving stack's recovery story (runtime/recovery.py, rung 15) used
+to guarantee bit-identical tokens only for requests *re-submitted*
+after revive/reformation — poison failed every in-flight request. This
+module is the durability half of rung 22: at quiescent boundaries the
+decode loop checkpoints each live request's resumable state here — KV
+pages as the verbatim host bytes ``kvcache.swapout_pages`` already
+produces (including int8 scale slabs), the emitted-token log, the
+sampler key, budgets, and the scheduler ticket — so ``revive()`` can
+re-admit the journaled requests into fresh slots instead of failing
+them, resuming decode from the checkpointed offset bit-identically.
+
+Design constraints:
+
+* **Dumb container, one owner.** Every method is called with the
+  serving work lock held (the journal lives inside the server's
+  single-lock discipline — locklint's L1/L4 apply to the caller, not
+  here). The journal itself takes no locks and runs no device ops.
+* **Bounded.** ``max_bytes`` caps the sum of checkpointed KV bytes
+  (0 = unbounded). A ``put`` that would blow the budget is refused —
+  the caller counts it as a skipped checkpoint and the request simply
+  keeps its previous (older but internally consistent) entry, or none.
+* **Per-request transactional.** ``put`` replaces the request's entry
+  atomically w.r.t. the budget: the old entry's bytes are released
+  before the new entry is admitted, so a mid-checkpoint fault leaves a
+  mix of newer/older entries, each individually resumable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Hashable
+
+
+@dataclass
+class JournalEntry:
+    """One request's resumable state, as of a quiescent boundary.
+
+    ``saved_len`` is ``len(prompt) + gen_len`` — the KV pool holds
+    positions ``0..saved_len-1`` and ``next_token`` is the pending
+    token to feed at position ``saved_len`` (exactly the preempt/resume
+    contract of rung 17). ``arrays`` are the verbatim host pages from
+    ``swapout_pages`` covering the first ``ceil(saved_len/page_size)``
+    pages of the slot; ``emitted`` is the count of tokens delivered to
+    the client's stream at checkpoint time (the exactly-once watermark
+    — regenerated tokens below it are suppressed on resume).
+    """
+
+    req: Any
+    pclass: str
+    ticket_no: int
+    admit_seq: int
+    pages_reserved: int
+    saved_len: int
+    gen_len: int
+    next_token: int
+    emitted: int
+    arrays: tuple = field(repr=False)
+    nbytes: int = 0
+
+
+class RequestJournal:
+    """request -> JournalEntry map with a byte budget. The key is any
+    hashable request identity (the serving layer uses its ``_Request``
+    object itself — request IDs can be absent or duplicated, the live
+    object cannot). Caller holds the lock."""
+
+    def __init__(self, max_bytes: int = 0):
+        if max_bytes < 0:
+            raise ValueError("max_bytes must be >= 0")
+        self.max_bytes = int(max_bytes)
+        self._entries: dict[Hashable, JournalEntry] = {}
+        self._nbytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._entries
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def get(self, key: Hashable) -> JournalEntry | None:
+        return self._entries.get(key)
+
+    def put(self, key: Hashable, entry: JournalEntry) -> bool:
+        """Replace ``key``'s entry. False (and no change) on budget."""
+        old = self._entries.get(key)
+        freed = old.nbytes if old is not None else 0
+        if self.max_bytes and self._nbytes - freed + entry.nbytes > self.max_bytes:
+            return False
+        self._nbytes += entry.nbytes - freed
+        self._entries[key] = entry
+        return True
+
+    def pop(self, key: Hashable) -> JournalEntry | None:
+        entry = self._entries.pop(key, None)
+        if entry is not None:
+            self._nbytes -= entry.nbytes
+        return entry
+
+    def take_all(self) -> list[JournalEntry]:
+        """Drain every entry, oldest ticket first (admission order)."""
+        entries = sorted(self._entries.values(),
+                         key=lambda e: (e.admit_seq, e.ticket_no))
+        self._entries.clear()
+        self._nbytes = 0
+        return entries
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._nbytes = 0

@@ -333,7 +333,7 @@ class RecoverySupervisor:
                 return
             self._attempts_total += 1
             try:
-                self._attempt_once()
+                restored = self._attempt_once()
             except Exception as e:
                 print(f"[kvedge-recover] attempt {attempt}/"
                       f"{self.policy.max_attempts} failed: {e!r}",
@@ -351,7 +351,8 @@ class RecoverySupervisor:
                 self.state = HEALTHY
                 self._settled.set()
             self._record("healed",
-                         f"attempt {attempt} in {took:.2f}s "
+                         f"attempt {attempt} in {took:.2f}s, "
+                         f"{restored} in-flight restored "
                          f"(was: {reason})")
             print(f"[kvedge-recover] pool healed in {took:.2f}s "
                   f"(attempt {attempt}; was: {reason})", flush=True)
@@ -365,10 +366,11 @@ class RecoverySupervisor:
               f"{self.policy.max_attempts} attempts; pool is terminal "
               f"(was: {reason})", flush=True)
 
-    def _attempt_once(self) -> None:
+    def _attempt_once(self) -> int:
         """One teardown -> reform -> revive -> warm-restart pass. Any
         exception fails the attempt (the pool stays poisoned and the
-        next attempt — or escalation — takes over)."""
+        next attempt — or escalation — takes over). Returns the count
+        of journaled in-flight requests revive() restored (rung 22)."""
         server = self.server
         # 1. Teardown: the decode loop exits on poison; wait for it so
         # revive() can install a fresh one. A loop still wedged past
@@ -393,7 +395,10 @@ class RecoverySupervisor:
             raise RecoveryError("supervisor stopped before revive")
         # 3. Warm restart: scrub + restart the pool in place (compiled
         # programs survive — this is the whole point vs rescheduling).
-        server.revive()
+        # revive() also re-admits every journaled in-flight request
+        # (rung 22 checkpoints) — the count rides into the healed
+        # record so a post-mortem shows how many requests survived.
+        restored = int(server.revive() or 0)
         # 4. Reload state: params from the latest checkpoint (best-
         # effort — the on-device params are intact unless the failure
         # corrupted them, and a missing checkpoint must not fail an
@@ -420,3 +425,4 @@ class RecoverySupervisor:
             except Exception as e:
                 print(f"[kvedge-recover] prefix reload skipped "
                       f"({e!r})", flush=True)
+        return restored

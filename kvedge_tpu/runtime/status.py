@@ -198,6 +198,29 @@ _SERVE_METRIC_FIELDS = (
      "requests rejected early by the overload watermarks "
      "(serving_sched_max_queue_depth / _wait_s) with a measured "
      "retry-after hint (paged backend)"),
+    # Durability (models/serving.py + runtime/journal.py, SERVING.md
+    # rung 22): boundary-checkpoint journal occupancy and the restores
+    # revive()/reformation performed — the coverage story for
+    # in-flight requests (paged backend, serving_checkpoint_every).
+    ("checkpoint_every", "serve_checkpoint_every", "gauge",
+     "configured checkpoint cadence in quiescent boundaries "
+     "(0 = durability off; paged backend)"),
+    ("journal_entries", "serve_journal_entries", "gauge",
+     "live requests with a resumable checkpoint in the host-side "
+     "journal (paged backend, serving_checkpoint_every)"),
+    ("journal_bytes", "serve_journal_bytes", "gauge",
+     "host RAM bytes held by journaled checkpoints (KV snapshots + "
+     "token logs), counted against the journal budget"),
+    ("checkpoints_total", "serve_checkpoints_total", "counter",
+     "per-request boundary checkpoints taken since boot"),
+    ("checkpoint_skipped_total", "serve_checkpoint_skipped_total",
+     "counter",
+     "checkpoints refused by the journal byte budget — those "
+     "requests degrade to fail-and-retry on the next outage"),
+    ("journal_restores_total", "serve_journal_restores_total",
+     "counter",
+     "journaled in-flight requests re-admitted by revive()/"
+     "reformation (direct slot restores + swap-set re-queues)"),
     # Request-scoped tracing (runtime/tracing.py, [payload]
     # serving_trace): flight-recorder occupancy and loss. Present only
     # while tracing is enabled.

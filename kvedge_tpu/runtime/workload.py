@@ -16,6 +16,8 @@ the status endpoint can report.
 
 from __future__ import annotations
 
+import collections
+import threading
 import time
 
 from kvedge_tpu.config.runtime_config import RuntimeConfig
@@ -1201,6 +1203,74 @@ def _parse_generate_request(doc: dict, tcfg, *, max_rows: int,
             priority, deadline_ms)
 
 
+class _ResumeLog:
+    """Bounded per-request delivery log backing client reconnects.
+
+    The durability rung (SERVING.md rung 22) keeps a poisoned pool's
+    in-flight requests alive server-side; this is the CLIENT half: the
+    serve path records every generated token it hands (or buffers for)
+    a request's consumer, keyed by request id, so a client that lost
+    its connection can reconnect with its ``X-Request-Id`` and an
+    ``emitted_offset`` and receive exactly the tokens it has not seen
+    — no duplicates, no gaps — whether the request is still decoding,
+    parked in the server's journal across a recovery, or finished.
+
+    Bounded to the ``max_entries`` most recently opened requests; an
+    evicted id simply cannot be resumed (the reconnect gets the same
+    400 an unknown id gets). Pump threads write and reconnect handlers
+    read under one condition variable; records are plain dicts mutated
+    only while holding it.
+    """
+
+    def __init__(self, max_entries: int = 64):
+        self.cond = threading.Condition()
+        self.max_entries = int(max_entries)
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+
+    def open(self, rid: str, n_rows: int, n_new: int) -> dict:
+        """Register ``rid`` (replacing any previous use of the id)."""
+        with self.cond:
+            rec = {"rows": [[] for _ in range(n_rows)],
+                   "live": n_rows, "n_new": n_new,
+                   "done": False, "error": None}
+            self._entries.pop(rid, None)
+            self._entries[rid] = rec
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+            return rec
+
+    def get(self, rid: str) -> dict | None:
+        with self.cond:
+            return self._entries.get(rid)
+
+    def append(self, rid: str, row: int, token: int) -> None:
+        with self.cond:
+            rec = self._entries.get(rid)
+            if rec is not None:
+                rec["rows"][row].append(token)
+                self.cond.notify_all()
+
+    def row_done(self, rid: str) -> None:
+        """One row finished; the record is done when all rows are."""
+        with self.cond:
+            rec = self._entries.get(rid)
+            if rec is not None:
+                rec["live"] -= 1
+                if rec["live"] <= 0:
+                    rec["done"] = True
+                self.cond.notify_all()
+
+    def finish(self, rid: str, error: Exception | None = None) -> None:
+        """Mark ``rid`` complete (the first error recorded wins)."""
+        with self.cond:
+            rec = self._entries.get(rid)
+            if rec is not None:
+                if error is not None and rec["error"] is None:
+                    rec["error"] = error
+                rec["done"] = True
+                self.cond.notify_all()
+
+
 def run_serve_payload(cfg: RuntimeConfig):
     """The ``serve`` payload: greedy decode behind ``POST /generate``.
 
@@ -1310,6 +1380,7 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
     row_pool = None
     paged_server = None
     recovery_sup = None
+    resume_log = None
     prefix_path, fp = "", ""
     try:
         if cache is not None or cfg.payload_serving == "paged":
@@ -1371,6 +1442,11 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 # twin of tools/locklint.py — *_locked calls assert
                 # ownership, Condition ops become thread-accurate.
                 debug_locks=cfg.serving_debug_locks,
+                # Durability (SERVING.md rung 22): boundary checkpoints
+                # of in-flight requests into the host journal, and the
+                # page-conservation audit at every quiescent boundary.
+                checkpoint_every=cfg.serving_checkpoint_every,
+                debug_pages=cfg.serving_debug_pages,
             )
             # Degraded-mode observability: when the pool poisons
             # (runtime/failures.py), persist a post-mortem failure
@@ -1491,9 +1567,123 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 max_workers=2 * slots,
                 thread_name_prefix="kvedge-serve-row",
             )
+            # Reconnect log (rung 22): only when boundary checkpoints
+            # are on — without them a disconnect still cancels rows,
+            # so there would be nothing durable to resume against.
+            if cfg.serving_checkpoint_every > 0:
+                resume_log = _ResumeLog()
         lock = threading.Lock()
 
+        def _resume(doc: dict) -> dict:
+            """Reconnect path (SERVING.md rung 22): ``X-Request-Id`` +
+            ``emitted_offset`` re-attaches to a previously issued
+            request and delivers exactly the generated tokens the
+            client has not seen. No new work is submitted — tokens
+            come from the delivery log the original request's pumps
+            keep feeding while the client is gone (a disconnect
+            detaches instead of cancelling when checkpointing is on),
+            so the stitched sequence is gap-free and duplicate-free
+            even across a poison/revive cycle."""
+            rid = clean_request_id(doc.get("_request_id"))
+            if not rid:
+                raise ValueError(
+                    "reconnect needs the original request id "
+                    "(X-Request-Id header or '_request_id')"
+                )
+            rec = resume_log.get(rid)
+            if rec is None:
+                raise ValueError(
+                    f"unknown or expired request id {rid!r}: nothing "
+                    "to resume (the delivery log keeps the "
+                    f"{resume_log.max_entries} most recent requests)"
+                )
+            n_rows = len(rec["rows"])
+            raw = doc.get("emitted_offset")
+            offs = raw if isinstance(raw, list) else [raw] * n_rows
+            if (len(offs) != n_rows
+                    or not all(isinstance(o, int)
+                               and not isinstance(o, bool)
+                               and 0 <= o <= rec["n_new"]
+                               for o in offs)):
+                raise ValueError(
+                    "'emitted_offset' must be an integer (or one per "
+                    f"row, {n_rows} here) in [0, n_new="
+                    f"{rec['n_new']}] — the count of generated "
+                    "tokens already received for the row"
+                )
+            stream = doc.get("stream", False)
+            if not isinstance(stream, bool):
+                raise ValueError("'stream' must be a boolean")
+            if not stream:
+                # Buffered reconnect: wait out the original request
+                # (its submitter is still parked on the server — across
+                # a recovery if need be), then hand back the per-row
+                # generated suffixes beyond the client's offsets.
+                with resume_log.cond:
+                    while not rec["done"]:
+                        resume_log.cond.wait()
+                    if rec["error"] is not None:
+                        raise rec["error"]
+                    suffix = [list(row[o:])
+                              for row, o in zip(rec["rows"], offs)]
+                return {"tokens": suffix, "n_new": rec["n_new"],
+                        "restored_step": restored_step,
+                        "request_id": rid, "resumed_at": list(offs)}
+
+            def replay():
+                # Streamed reconnect: drain the log beyond the offsets,
+                # then follow it live until the original request's
+                # pumps mark the record done. Tokens are read under the
+                # log's condition but yielded outside it (the HTTP
+                # write must not hold the log against the pumps).
+                cursor = list(offs)
+                while True:
+                    out = []
+                    with resume_log.cond:
+                        while True:
+                            for i in range(n_rows):
+                                row = rec["rows"][i]
+                                if cursor[i] < len(row):
+                                    out.extend(
+                                        (i, t)
+                                        for t in row[cursor[i]:]
+                                    )
+                                    cursor[i] = len(row)
+                            if out or rec["done"]:
+                                done = rec["done"]
+                                err = rec["error"]
+                                break
+                            resume_log.cond.wait()
+                    for i, t in out:
+                        yield {"row": i, "token": t}
+                    if done:
+                        if err is not None:
+                            raise err
+                        yield {
+                            "done": True,
+                            "tokens": [list(r[o:]) for r, o
+                                       in zip(rec["rows"], offs)],
+                            "n_new": rec["n_new"],
+                            "restored_step": restored_step,
+                            "request_id": rid,
+                            "resumed_at": list(offs),
+                        }
+                        return
+
+            return {"_stream": replay(), "request_id": rid}
+
         def _serve(doc: dict) -> dict:
+            if "emitted_offset" in doc:
+                # Reconnect, not a new request: every other body field
+                # (tokens, sampling, budgets) is pinned by the original
+                # submission and must not be re-parsed here.
+                if resume_log is None:
+                    raise ValueError(
+                        "'emitted_offset' reconnect requires the paged "
+                        "backend with [payload] "
+                        "serving_checkpoint_every > 0"
+                    )
+                return _resume(doc)
             (tokens, n_new, temperature, top_p, seed, stream, spec,
              priority, deadline_ms) = (
                 _parse_generate_request(
@@ -1613,6 +1803,12 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                                 src.cancel()
                         raise
 
+                    # The 200 is committed: register the request for
+                    # reconnects BEFORE any token leaves, so a client
+                    # that dies on the first frame can still resume.
+                    if resume_log is not None:
+                        resume_log.open(rid, len(prompts), n_new)
+
                     _ROW_DONE = object()
 
                     def ndjson():
@@ -1625,13 +1821,26 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                         out_q = queue_mod.SimpleQueue()
 
                         def pump(i):
+                            # Pumps feed the reconnect log DIRECTLY —
+                            # not via the merger — so a dead merger
+                            # (client gone) never stops the log, and a
+                            # detached request keeps journaling its
+                            # delivery for the eventual reconnect.
                             try:
                                 out_q.put((i, firsts[i]))
+                                if resume_log is not None:
+                                    resume_log.append(rid, i, firsts[i])
                                 for token in sources[i]:
                                     out_q.put((i, token))
+                                    if resume_log is not None:
+                                        resume_log.append(rid, i, token)
                                 out_q.put((i, _ROW_DONE))
+                                if resume_log is not None:
+                                    resume_log.row_done(rid)
                             except Exception as e:
                                 out_q.put((i, e))
+                                if resume_log is not None:
+                                    resume_log.finish(rid, error=e)
 
                         # Pumps ride the same bounded pool. Rows beyond
                         # the worker count pump after earlier rows
@@ -1659,15 +1868,21 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                                 yield {"row": i, "token": item}
                         except GeneratorExit:
                             # The HTTP layer closed us: the client is
-                            # gone. Cancel every row so slots and pages
-                            # free at the next decode boundary instead
-                            # of decoding out the reserved budgets
-                            # (models/serving.py cancel); the pump
-                            # threads unblock on the RequestCancelled
-                            # their streams receive.
-                            for src in sources:
-                                if src is not None:
-                                    src.cancel()
+                            # gone. Without durability, cancel every
+                            # row so slots and pages free at the next
+                            # decode boundary instead of decoding out
+                            # the reserved budgets (models/serving.py
+                            # cancel); the pump threads unblock on the
+                            # RequestCancelled their streams receive.
+                            # With checkpointing on (rung 22) the
+                            # disconnect DETACHES instead: the rows
+                            # decode on, the pumps keep feeding the
+                            # reconnect log, and the client stitches
+                            # the stream back with emitted_offset.
+                            if resume_log is None:
+                                for src in sources:
+                                    if src is not None:
+                                        src.cancel()
                             raise
                         yield {
                             "done": True,
@@ -1690,7 +1905,23 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                         request_id=rid,
                     )
 
-                fan_out_rows(len(tokens), one_row)
+                # Buffered requests register for reconnect too: the
+                # submitter blocks server-side through a recovery, so
+                # a client whose connection died mid-wait re-asks with
+                # emitted_offset=0 and collects the finished tokens.
+                if resume_log is not None:
+                    resume_log.open(rid, len(tokens), n_new)
+                try:
+                    fan_out_rows(len(tokens), one_row)
+                except Exception as e:
+                    if resume_log is not None:
+                        resume_log.finish(rid, error=e)
+                    raise
+                if resume_log is not None:
+                    for i, row in enumerate(rows):
+                        for t in row[len(tokens[i]):]:
+                            resume_log.append(rid, i, t)
+                    resume_log.finish(rid)
                 return {
                     "tokens": rows,
                     "n_new": n_new,
@@ -1810,6 +2041,11 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
             (lambda: paged_server.degraded)
             if paged_server is not None else (lambda: None)
         )
+        # Lock-free capacity probe for /healthz's recovering payload
+        # (satellite of rung 22): pages_free/pages_total/bucket as bare
+        # attribute reads — same no-lock contract as `degraded`.
+        if paged_server is not None:
+            serve_fn.capacity = paged_server.capacity_probe
         # Recovery-machine probe for /healthz: while the supervisor is
         # recovering, boot.health_detail reports 503 NON-terminal with
         # a retry-after hint; terminal only after escalation.

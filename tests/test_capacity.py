@@ -533,6 +533,204 @@ def test_capacity_knobs_validate():
         _payload_cfg(serving_min_bucket=-1).validate()
 
 
+# ---- rung 22 x capacity: checkpoints under preemption + watermarks -------
+
+
+def _stream_in_background(server, prompt, n_new, **kw):
+    """Drive a stream from a daemon thread; returns (got, done, errs).
+    No consumer timeout: a journaled request PARKS across poison/revive
+    (rung 22) and the test owns the deadline."""
+    got: list[int] = []
+    errs: list[Exception] = []
+    done = threading.Event()
+
+    def consume():
+        try:
+            for tok in server.submit_stream(prompt, n_new, **kw):
+                got.append(tok)
+        except Exception as e:
+            errs.append(e)
+        finally:
+            done.set()
+
+    threading.Thread(target=consume, daemon=True).start()
+    return got, done, errs
+
+
+def _wait_degraded(server, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while server.degraded is None:
+        assert time.monotonic() < deadline, "pool never poisoned"
+        time.sleep(0.01)
+
+
+def _arm_kill(server, ready, message):
+    """Raise at the first decode seam (serial window or overlapped
+    harvest, whichever this server shape uses) where ``ready()`` holds."""
+    cache = server._cache
+    real_h, real_w = cache.harvest_window, cache._device_window
+    state = {"arm": True}
+
+    def fire():
+        if state["arm"] and ready():
+            state["arm"] = False
+            raise RuntimeError(message)
+
+    def dying_h(handle):
+        fire()
+        return real_h(handle)
+
+    def dying_w(*args):
+        fire()
+        return real_w(*args)
+
+    cache.harvest_window = dying_h
+    cache._device_window = dying_w
+
+
+def test_poison_with_swapped_victim_revives_all(params):
+    """Rung 22 x rung 17: the pool poisons while a preempted victim
+    sits in the swap set. Its host snapshot is ALREADY a verbatim
+    checkpoint, so revive brings back all three requests — the two
+    checkpointed actives refill the slots and the swapped victim
+    re-queues under its original ticket (more checkpoints than slots)
+    to resume at a boundary — and every one completes bit-identical."""
+    server = PagedGenerationServer(
+        params, CFG, slots=2, pages=24, page_size=4, window=4,
+        min_bucket=1, sched_policy="strict", sched_swap_budget_mb=64,
+        checkpoint_every=1, prefix_cache=False,
+    )
+    victim_prompt = [9, 8, 7]
+    dying_thread = server._thread
+    try:
+        victims = [server.submit_stream(victim_prompt, n_new=40,
+                                        priority="batch")
+                   for _ in range(2)]
+        firsts = [next(v) for v in victims]  # both slots held
+        # Fire only once the interactive arrival has preempted a victim
+        # (swap bytes parked) AND everything holds a checkpoint: both
+        # actives plus the victim's pre-swap entry.
+        _arm_kill(
+            server,
+            lambda: (server._sched.swap_bytes > 0
+                     and len(server._journal) >= 3),
+            "injected: died with a swapped-out victim",
+        )
+        tails: list[list[int]] = [[], []]
+
+        def drain(i):
+            for tok in victims[i]:
+                tails[i].append(tok)
+
+        threads = [threading.Thread(target=drain, args=(i,),
+                                    daemon=True) for i in range(2)]
+        for t in threads:
+            t.start()
+        inter: dict = {}
+
+        def interactive():
+            try:
+                inter["tokens"] = server.submit(
+                    [40, 41, 42], n_new=6, priority="interactive")
+            except Exception as e:
+                inter["error"] = e
+
+        it = threading.Thread(target=interactive, daemon=True)
+        it.start()
+        _wait_degraded(server)
+        dying_thread.join(timeout=30)
+        assert not dying_thread.is_alive()
+        assert server.revive() == 3
+        it.join(timeout=120)
+        for t in threads:
+            t.join(timeout=120)
+        assert "error" not in inter, inter
+        assert inter["tokens"] == reference(params, [40, 41, 42], 6)
+        want_v = reference(params, victim_prompt, 40)
+        for f, tail in zip(firsts, tails):
+            assert victim_prompt + [f] + tail == want_v
+        stats = server.stats()
+        assert stats["journal_restores_total"] == 3
+        assert stats["journal_entries"] == 0
+        assert stats["sched_swap_bytes_host"] == 0
+    finally:
+        server.close()
+
+
+def test_checkpointed_spec_overlap_revive_bit_identical(params):
+    """Rung 22 x rungs 16/20/21: boundary checkpoints compose with the
+    overlapped pipeline, device-resident spec windows, and bucketing.
+    The fault lands INSIDE the second checkpoint's swapout — the first
+    checkpoint is already durable, so revive resumes from it and the
+    stream completes bit-identical with no replayed token."""
+    server = PagedGenerationServer(
+        params, CFG, slots=4, pages=32, page_size=4, min_bucket=1,
+        overlap="on", speculative=2, spec_window=2, checkpoint_every=1,
+        prefix_cache=False,
+    )
+    prompt = [5, 9, 2]
+    want = reference(params, prompt, 10)
+    cache = server._cache
+    real = cache.swapout_pages
+    calls = [0]
+
+    def dying(ids):
+        calls[0] += 1
+        if calls[0] == 2:
+            raise RuntimeError("injected: swapout died mid-checkpoint")
+        return real(ids)
+
+    cache.swapout_pages = dying
+    dying_thread = server._thread
+    try:
+        got, done, errs = _stream_in_background(server, prompt, 10)
+        _wait_degraded(server)
+        cache.swapout_pages = real
+        dying_thread.join(timeout=30)
+        assert not dying_thread.is_alive()
+        assert server.revive() == 1
+        assert done.wait(timeout=60)
+        assert not errs, errs
+        assert prompt + got == want
+        assert server.stats()["journal_restores_total"] == 1
+    finally:
+        server.close()
+
+
+def test_revive_under_low_watermark_keeps_shedding(params):
+    """Rung 22 x rung 21 watermarks: a checkpointed interactive request
+    survives poison/revive in a watermark-tight pool, the revived pool
+    still sheds batch arrivals below the low watermark, and the
+    restored request completes bit-identical."""
+    server = PagedGenerationServer(
+        params, CFG, slots=2, pages=16, page_size=4, window=2,
+        page_low_watermark=0.95, checkpoint_every=1,
+        prefix_cache=False,
+    )
+    prompt = [5, 9, 2]
+    want = reference(params, prompt, 8)
+    _arm_kill(server, lambda: len(server._journal) >= 1,
+              "injected: died under the low watermark")
+    dying_thread = server._thread
+    try:
+        got, done, errs = _stream_in_background(
+            server, prompt, 8, priority="interactive")
+        _wait_degraded(server)
+        dying_thread.join(timeout=30)
+        assert not dying_thread.is_alive()
+        assert server.revive() == 1
+        # The revived pool keeps the watermark discipline: batch sheds
+        # with page terms while the restored request still runs.
+        with pytest.raises(ServerOverloaded, match="low watermark"):
+            server.submit([1, 2], n_new=4, priority="batch")
+        assert done.wait(timeout=60)
+        assert not errs, errs
+        assert prompt + got == want
+        assert server.stats()["sched_shed_total"] >= 1
+    finally:
+        server.close()
+
+
 # ---- observability -------------------------------------------------------
 
 

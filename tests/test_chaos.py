@@ -1,0 +1,207 @@
+"""Crash-surviving in-flight requests: the chaos soak (SERVING.md rung 22).
+
+The durability contract under test: with boundary checkpoints on, a
+pool that poisons mid-decode — mid-window, mid-spec-harvest, mid-swap,
+mid-pipeline-harvest — revives with every journaled in-flight request
+restored into a fresh slot and completes it BIT-IDENTICAL to an
+uninterrupted run, while the global invariants hold at every settle
+point: page conservation, no stuck tickets, monotone emitted offsets,
+typed failures only.
+
+Two legs share one harness (``testing/chaos.py``):
+
+* a short deterministic subset — pinned server shapes, seeds chosen to
+  exercise revive-with-restore on the serial loop, the overlapped
+  pipeline, and windowed speculation — fast enough for tier-1;
+* the seeded soak — ``@slow``, 24 campaigns whose whole decision
+  stream (server shape, prompts, consumer mix, fault plans) derives
+  from the campaign seed.
+
+Plus the ``serving_debug_pages`` audit's loud-failure contract: a
+seeded page leak (a FaultyCache subclass stealing a page at the admit
+seam) must poison the pool with the typed, non-retryable
+``PageAccountingError`` at the next quiescent boundary.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kvedge_tpu.models import TransformerConfig, generate, init_params
+from kvedge_tpu.models.serving import PagedGenerationServer
+from kvedge_tpu.runtime.failures import (
+    PageAccountingError,
+    ServingFailure,
+)
+from kvedge_tpu.testing.chaos import run_chaos_campaign
+from kvedge_tpu.testing.servingfaults import FaultyCache
+
+pytestmark = pytest.mark.chaos
+
+CFG = TransformerConfig(
+    vocab=128, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2, d_ff=64,
+    max_seq=64,
+)
+
+# Pinned server shapes for the deterministic tier-1 subset: one per
+# decode body the durability machinery hooks into.
+SERIAL = dict(checkpoint_every=1, overlap="off", window=2,
+              speculative=0, spec_window=0)
+OVERLAP = dict(checkpoint_every=1, overlap="on", window=2,
+               speculative=0, spec_window=0)
+SPEC = dict(checkpoint_every=2, overlap="off", window=2,
+            speculative=2, spec_window=0)
+SPECW = dict(checkpoint_every=1, overlap="off", window=2,
+             speculative=2, spec_window=2)
+
+ROUNDS = 2
+PER_ROUND = 3
+
+_ORACLE_MEMO: dict = {}
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def oracle(params):
+    """Fault-free greedy reference, memoized across campaigns (the
+    prompts are seed-drawn, so collisions across campaigns are real
+    compile savings, not luck)."""
+
+    def fn(prompt, n_new):
+        key = (tuple(prompt), n_new)
+        if key not in _ORACLE_MEMO:
+            out = generate(params, jnp.asarray([prompt], jnp.int32),
+                           CFG, n_new=n_new)
+            _ORACLE_MEMO[key] = [int(t) for t in np.asarray(out)[0]]
+        return _ORACLE_MEMO[key]
+
+    return fn
+
+
+# ---- deterministic subset (tier-1): revive-with-restore per shape --------
+
+
+@pytest.mark.parametrize(
+    "seed,config",
+    [(2, SERIAL), (9, SERIAL), (2, OVERLAP), (9, SPEC)],
+    ids=["serial-2", "serial-9", "overlap-2", "spec-9"],
+)
+def test_deterministic_campaign(params, oracle, seed, config):
+    """Seeds pinned to poison at least once per campaign: the run must
+    revive, restore journaled requests, and finish every survivor
+    bit-identical (the harness raises InvariantViolation otherwise)."""
+    res = run_chaos_campaign(
+        params, CFG, seed=seed, rounds=ROUNDS,
+        requests_per_round=PER_ROUND, n_new=6, config=config,
+        oracle=oracle,
+    )
+    assert res.completed + res.failed == ROUNDS * PER_ROUND
+    # These seeds are chosen BECAUSE they poison mid-flight with
+    # journaled work to bring back — a campaign that stops exercising
+    # the restore path is a regression even if nothing else breaks.
+    assert res.revives >= 1, res.fired
+    assert res.restored_total >= 1, res.fired
+    # Restored requests complete: failures are only ever the typed
+    # pre-admission kind, never the whole round.
+    assert res.completed >= res.restored_total
+
+
+def test_campaign_decisions_replay_from_seed(params, oracle):
+    """Same seed, same decisions: server shape, prompts, and fault
+    plans replay exactly (the trace records them). Seam ARRIVAL order
+    still depends on thread interleaving — that is what the trace is
+    for — so the replay contract is the decision stream, not the
+    firing seam."""
+    a = run_chaos_campaign(params, CFG, seed=9, rounds=ROUNDS,
+                           requests_per_round=PER_ROUND, n_new=6,
+                           config=SERIAL, oracle=oracle)
+    b = run_chaos_campaign(params, CFG, seed=9, rounds=ROUNDS,
+                           requests_per_round=PER_ROUND, n_new=6,
+                           config=SERIAL, oracle=oracle)
+    assert a.config == b.config
+    # Decision lines (plans, submissions) are positionally identical;
+    # runtime lines (revives, outcomes) may interleave differently.
+    decisions = [ln for ln in a.trace
+                 if ln.startswith(("[campaign]", "[plan]"))
+                 or "submit" in ln]
+    assert decisions == [ln for ln in b.trace
+                         if ln.startswith(("[campaign]", "[plan]"))
+                         or "submit" in ln]
+    assert a.completed + a.failed == b.completed + b.failed
+
+
+# ---- the seeded soak (slow): drawn shapes, >= 20 campaigns ---------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(100, 124))
+def test_soak_campaign(params, oracle, seed):
+    """Randomized multi-fault campaigns: server shape, prompts,
+    consumer mix, and per-round fault plans all drawn from the seed.
+    Zero invariant violations over the fleet is the acceptance bar."""
+    res = run_chaos_campaign(
+        params, CFG, seed=seed, rounds=ROUNDS,
+        requests_per_round=PER_ROUND, n_new=6, oracle=oracle,
+    )
+    assert res.completed + res.failed == ROUNDS * PER_ROUND
+
+
+# ---- serving_debug_pages: a seeded leak fails loud and typed -------------
+
+
+class _LeakyCache(FaultyCache):
+    """Steals one free page at the first admit — the books then claim
+    one fewer page than the pool owns, exactly the class of host-side
+    bug the boundary audit exists to catch."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.leaked = False
+
+    def admit(self, *args, **kwargs):
+        out = super().admit(*args, **kwargs)
+        if not self.leaked and self._free:
+            self._free.pop()
+            self.leaked = True
+        return out
+
+
+def test_debug_pages_audit_trips_on_seeded_leak(params):
+    cache = _LeakyCache(CFG, slots=2, pages=16, page_size=4)
+    server = PagedGenerationServer(params, CFG, cache=cache,
+                                   debug_pages=True, prefix_cache=False)
+    try:
+        with pytest.raises(ServingFailure):
+            server.submit([3, 1, 4], n_new=6)
+        # The poison is the TYPED audit failure, and it is terminal:
+        # a replacement process running the same code leaks the same
+        # way, so retrying against it would be a lie.
+        assert isinstance(server._poison, PageAccountingError)
+        assert server._poison.retryable is False
+        assert "free" in str(server._poison)
+    finally:
+        server.close()
+
+
+def test_debug_pages_audit_passes_clean_pool(params):
+    """The audit is a no-op on a healthy pool — whole requests run
+    under it without tripping, and the books balance at close."""
+    cache = FaultyCache(CFG, slots=2, pages=16, page_size=4)
+    server = PagedGenerationServer(params, CFG, cache=cache,
+                                   debug_pages=True, checkpoint_every=1,
+                                   prefix_cache=False)
+    try:
+        out = server.submit([3, 1, 4], n_new=6)
+        want = generate(params, jnp.asarray([[3, 1, 4]], jnp.int32),
+                        CFG, n_new=6)
+        assert out == [int(t) for t in np.asarray(want)[0]]
+        assert server.degraded is None
+        acct = cache.page_accounting()
+        assert acct["free"] == acct["pages_total"]
+    finally:
+        server.close()

@@ -246,6 +246,188 @@ def test_revive_requires_a_poisoned_pool(params):
         server.revive()
 
 
+# ---- rung 22: boundary checkpoints + resume-after-revive ----------------
+
+
+def _stream_in_background(server, prompt, n_new):
+    """Drive a stream from a daemon thread; returns (got, done, errs).
+    No consumer timeout on purpose: a journaled request PARKS across
+    poison/revive (rung 22), and the test owns the deadline."""
+    got: list[int] = []
+    errs: list[Exception] = []
+    done = threading.Event()
+
+    def consume():
+        try:
+            for tok in server.submit_stream(prompt, n_new):
+                got.append(tok)
+        except Exception as e:
+            errs.append(e)
+        finally:
+            done.set()
+
+    threading.Thread(target=consume, daemon=True).start()
+    return got, done, errs
+
+
+def _wait_degraded(server, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while server.degraded is None:
+        assert time.monotonic() < deadline, "pool never poisoned"
+        time.sleep(0.01)
+
+
+def test_single_host_revive_restores_in_flight(params):
+    """The rung-22 acceptance scenario, single host: a pool poisoned
+    MID-DECODE (two windows already streamed and checkpointed) revives
+    with the in-flight request re-admitted from its boundary
+    checkpoint, and the stream completes gap-free and bit-identical to
+    an uninterrupted run — delivered tokens are never replayed."""
+    cache = FaultyCache(CFG, slots=2, pages=16, page_size=4)
+    server = PagedGenerationServer(params, CFG, cache=cache, window=2,
+                                   checkpoint_every=1,
+                                   prefix_cache=False)
+    prompt = [3, 1, 4, 1, 5]
+    want = reference(params, prompt, 8)
+    real = cache.harvest_window
+    calls = [0]
+
+    def dying(handle):
+        calls[0] += 1
+        if calls[0] == 3:  # windows 1+2 harvested -> 2 checkpoints done
+            raise RuntimeError("injected: harvest died mid-decode")
+        return real(handle)
+
+    dying_thread = server._thread
+    try:
+        cache.harvest_window = dying
+        got, done, errs = _stream_in_background(server, prompt, 8)
+        _wait_degraded(server)
+        cache.harvest_window = real
+        _join_dying(dying_thread)
+        # The journaled request is PARKED, not failed: its waiter stays
+        # blocked while the checkpoint holds its pages + stream offset.
+        assert not done.is_set()
+        assert server.stats()["journal_entries"] == 1
+        assert server.revive() == 1
+        assert done.wait(timeout=60)
+        assert not errs, errs
+        assert prompt + got == want
+        stats = server.stats()
+        assert stats["journal_restores_total"] == 1
+        assert stats["journal_entries"] == 0
+        assert server.degraded is None
+    finally:
+        server.close()
+
+
+def test_slice_reformation_restores_in_flight(params, mesh):
+    """The slice twin: a follower's broadcast dies mid-decode on a
+    checkpointing slice server, the supervisor re-forms the op stream
+    and revives — and the journaled request is restored THROUGH the
+    re-formed transport (admit + swapin replay on the rejoined
+    followers), completing bit-identical in the same process."""
+    cache = SlicePagedKVCache(
+        CFG, slots=3, pages=24, page_size=4, mesh=mesh,
+        op_budgets=OpBudgets(**BUDGETS),
+    )
+    server = PagedGenerationServer(params, CFG, cache=cache, window=2,
+                                   checkpoint_every=1,
+                                   prefix_cache=False)
+    prompt = [3, 1, 4, 1, 5]
+    want = reference(params, prompt, 8)
+    # Warm: every op key compiled and on the STEADY budget — the state
+    # a long-lived pool fails in (and the seam count below starts AFTER
+    # this request, so the fire index is stable).
+    assert server.submit(prompt, n_new=8) == want
+    plan = FaultPlan(seed=3, kinds=("raise",), fire_window=(8, 9))
+    FaultySliceTransport(cache, plan)
+    sup = RecoverySupervisor(
+        server, policy=RecoveryPolicy(max_attempts=3, **FAST), seed=5,
+    ).attach()
+    dying = server._thread
+    try:
+        got, done, errs = _stream_in_background(server, prompt, 8)
+        _wait_degraded(server)
+        _join_dying(dying)
+        assert sup.wait_settled(timeout=60.0) == HEALTHY
+        assert done.wait(timeout=60)
+        assert not errs, errs
+        assert prompt + got == want
+        stats = server.stats()
+        assert stats["journal_restores_total"] == 1
+        assert stats["journal_entries"] == 0
+        assert server.degraded is None
+    finally:
+        server.close()
+        plan.close()
+
+
+def test_revive_restores_prepoison_bucket_without_retrace(params):
+    """Satellite of rung 22: a pool poisoned while the capacity bucket
+    is stepped UP revives at the pre-poison rung — the journal
+    re-admissions need the width, and the compiled programs for it
+    survived — so an identical post-revive round triggers ZERO new
+    traces. The 2-entry restore is itself the rung proof: admit refuses
+    any slot at or above the bucket, so both re-admissions succeeding
+    means revive set rung 2 back before touching the cache."""
+    from kvedge_tpu.models import kvcache as kvcache_mod
+
+    # page_size 16 >> any request here: every slot holds exactly ONE
+    # page, so checkpoint gathers and restore scatters are shape-stable
+    # across rounds regardless of where the boundary clock lands.
+    cache = FaultyCache(CFG, slots=2, pages=8, page_size=16,
+                        min_bucket=1)
+    server = PagedGenerationServer(params, CFG, cache=cache, window=2,
+                                   checkpoint_every=1, overlap="off",
+                                   prefix_cache=False)
+    prompts = ([5, 9, 2], [1, 4, 3])
+    wants = [reference(params, p, 12) for p in prompts]
+    real = cache._device_window
+    state = {"arm": False}
+
+    def dying(*args):
+        # Fire only once BOTH live requests hold a checkpoint (the
+        # boundary just crossed checkpointed everything live): the
+        # restore is then deterministically 2 entries wide, however
+        # the admission interleaving fell this round.
+        if state["arm"] and len(server._journal) == 2:
+            state["arm"] = False
+            raise RuntimeError("injected: died with bucket stepped up")
+        return real(*args)
+
+    cache._device_window = dying
+
+    def round_trip():
+        state["arm"] = True
+        dying_thread = server._thread
+        drives = [_stream_in_background(server, p, 12)
+                  for p in prompts]
+        _wait_degraded(server)
+        _join_dying(dying_thread)
+        assert server.revive() == 2
+        for got, done, errs in drives:
+            assert done.wait(timeout=60)
+            assert not errs, errs
+        for (got, _, _), (p, want) in zip(drives, zip(prompts, wants)):
+            assert list(p) + got == want
+
+    try:
+        # Warm every program shape a round can touch: the solo run
+        # compiles rung 1 (and its checkpoint gather), the first
+        # poison/revive round compiles rung 2 plus the restore path.
+        server.submit(prompts[0], n_new=12)
+        round_trip()
+        pinned = kvcache_mod.trace_count()
+        round_trip()
+        assert kvcache_mod.trace_count() == pinned, (
+            "revive lost the pre-poison bucket rung: the replay round "
+            "recompiled"
+        )
+    finally:
+        server.close()
+
+
 # ---- crash-loop breaker + the init-events record ------------------------
 
 

@@ -283,3 +283,22 @@ def test_serving_kv_dtype_round_trips_and_validates():
     assert RuntimeConfig.parse("").serving_kv_dtype == ""
     with pytest.raises(RuntimeConfigError):
         RuntimeConfig.parse("[payload]\nserving_kv_dtype = 'fp8'\n")
+
+
+def test_serving_checkpoint_knobs_round_trip_and_validate():
+    """Rung 22 knobs: checkpoint cadence (0 = off, today's
+    fail-and-retry semantics) and the page-conservation audit."""
+    cfg = RuntimeConfig.parse(
+        "[payload]\nserving = 'paged'\nserving_checkpoint_every = 16\n"
+        "serving_debug_pages = true\n"
+    )
+    assert cfg.serving_checkpoint_every == 16
+    assert cfg.serving_debug_pages is True
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    default = RuntimeConfig.parse("")
+    assert default.serving_checkpoint_every == 0
+    assert default.serving_debug_pages is False
+    for bad in ("serving_checkpoint_every = -1",
+                "serving_debug_pages = 'yes'"):
+        with pytest.raises(RuntimeConfigError):
+            RuntimeConfig.parse(f"[payload]\n{bad}\n")

@@ -208,6 +208,12 @@ def main(argv: list[str] | None = None) -> int:
                          "(forwards -m capacity: bucketed compile "
                          "cache, HBM page budget, watermark shed and "
                          "resume gates)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run only the crash-survival durability tests "
+                         "(forwards -m chaos: boundary checkpoints, "
+                         "resume-after-revive, page-audit trips, and — "
+                         "without the tier-1 'not slow' filter — the "
+                         "full seeded soak)")
     ap.add_argument("--lint", action="store_true",
                     help="run the lock-discipline gate: tools/locklint.py "
                          "over kvedge_tpu/, then the analyzer's own tests "
@@ -235,6 +241,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "window"]
     if args.capacity:
         args.pytest_args += ["-m", "capacity"]
+    if args.chaos:
+        args.pytest_args += ["-m", "chaos"]
     if args.lint:
         # The analyzer gate runs FIRST and fast-fails: a tree with
         # unsuppressed findings should not spend minutes in pytest
