@@ -379,10 +379,16 @@ class RuntimeConfig:
     # of taxing every pass (the r05 paged-spec soft spot: 69.5 tok/s
     # vs 1803 plain paged, one RTT per pass). Requires
     # serving_speculative > 0 and the overlapped loop; an all-greedy
-    # batch rides windows, a sampled co-tenant falls back to the
-    # legacy per-pass path. Token streams are bit-identical either
+    # batch rides windows. Token streams are bit-identical either
     # way. 0 = off (legacy per-pass speculation).
     serving_spec_window: int = 0
+    # Rung 23: keep mixed greedy+sampled batches on the windowed spec
+    # path (sampled rows draw their next token on device, exact key
+    # schedule preserved). false = a sampled co-tenant collapses the
+    # batch to the legacy per-pass program (counted in
+    # spec_window_fallbacks_total{cause="sampled"}). No effect unless
+    # serving_spec_window > 0.
+    serving_spec_sampled_window: bool = True
     # Retry-after hint (seconds) carried by poisoned-pool refusals and
     # /healthz while degraded — what a refused client is told to wait
     # before retrying. When the recovery supervisor is active and a
@@ -615,6 +621,10 @@ class RuntimeConfig:
                     payload_doc.get("serving_spec_window",
                                     cls.serving_spec_window)
                 ),
+                serving_spec_sampled_window=payload_doc.get(
+                    "serving_spec_sampled_window",
+                    cls.serving_spec_sampled_window
+                ),
                 serving_speculative=_parse_speculative(
                     payload_doc.get("serving_speculative",
                                     cls.serving_speculative)
@@ -828,6 +838,10 @@ class RuntimeConfig:
                 "[payload] serving_spec_window > 0 needs speculative "
                 "decoding (serving_speculative 'auto' or > 0)"
             )
+        if not isinstance(self.serving_spec_sampled_window, bool):
+            raise RuntimeConfigError(
+                "[payload] serving_spec_sampled_window must be a boolean"
+            )
         if self.serving_retry_after_s <= 0:
             raise RuntimeConfigError(
                 "[payload] serving_retry_after_s must be > 0 "
@@ -978,6 +992,8 @@ class RuntimeConfig:
             "serving_speculative = "
             f"{s(self.serving_speculative) if isinstance(self.serving_speculative, str) else self.serving_speculative}\n"
             f"serving_spec_window = {self.serving_spec_window}\n"
+            "serving_spec_sampled_window = "
+            f"{'true' if self.serving_spec_sampled_window else 'false'}\n"
             f"serving_retry_after_s = {self.serving_retry_after_s}\n"
             f"serving_recovery_attempts = {self.serving_recovery_attempts}\n"
             f"serving_sched_policy = {s(self.serving_sched_policy)}\n"

@@ -837,7 +837,7 @@ class PagedKVCache:
         return _np.maximum(caps, 0).astype(_np.int32)
 
     def dispatch_window(self, params, tokens, n_steps: int, active=None,
-                        steps_left=None):
+                        steps_left=None, stop_tokens=None):
         """Enqueue a greedy decode window WITHOUT forcing its result.
 
         The pipelined twin of :meth:`step_window`: returns the produced
@@ -855,9 +855,17 @@ class PagedKVCache:
         :func:`_paged_decode_window_capped_impl`), which is what makes
         a speculatively dispatched window safe. Pages and host lengths
         advance by each row's TRUE advance, never the full window.
+
+        ``stop_tokens`` [slots] int32 (None = no stops) rides the scan
+        as per-row stop-token detection; the harvested result carries
+        the packed ``[fin, stop_at]`` bookkeeping rows (rung 23).
         """
+        import numpy as _np
+
         slots = self._step_slots(active)
         caps = self._window_caps(n_steps, steps_left)
+        if stop_tokens is None:
+            stop_tokens = _np.full(self.bucket, -1, _np.int32)
         grew = False
         for slot in slots:
             if caps[slot] > 0:
@@ -865,7 +873,7 @@ class PagedKVCache:
         if grew:
             self._sync()
         toks = self._device_window_dispatch(
-            params, tokens, n_steps, active, caps
+            params, tokens, n_steps, active, caps, stop_tokens
         )
         for slot in slots:
             self._host_lengths[slot] += int(caps[slot])
@@ -873,12 +881,17 @@ class PagedKVCache:
 
     def dispatch_window_sampled(self, params, tokens, n_steps: int,
                                 active, key_data, base_steps, temps,
-                                top_ps, sampled_mask, steps_left=None):
+                                top_ps, sampled_mask, steps_left=None,
+                                stop_tokens=None):
         """Mixed greedy/sampled :meth:`dispatch_window` (same carry,
-        cap, and growth discipline; sampling inputs as in
+        cap, growth, and stop-token discipline; sampling inputs as in
         :meth:`step_window_sampled`)."""
+        import numpy as _np
+
         slots = self._step_slots(active)
         caps = self._window_caps(n_steps, steps_left)
+        if stop_tokens is None:
+            stop_tokens = _np.full(self.bucket, -1, _np.int32)
         grew = False
         for slot in slots:
             if caps[slot] > 0:
@@ -887,7 +900,7 @@ class PagedKVCache:
             self._sync()
         toks = self._device_window_sampled_dispatch(
             params, tokens, n_steps, active, key_data, base_steps,
-            temps, top_ps, sampled_mask, caps,
+            temps, top_ps, sampled_mask, caps, stop_tokens,
         )
         for slot in slots:
             self._host_lengths[slot] += int(caps[slot])
@@ -895,9 +908,10 @@ class PagedKVCache:
 
     def harvest_window(self, handle):
         """Force a dispatched window's tokens to the host
-        ([n_steps, slots] int32). Blocks until the device finishes that
-        window — ideally while a later window is already queued behind
-        it (the overlap)."""
+        ([n_steps + 2, slots] int32: the produced tokens plus the
+        packed ``[fin, stop_at]`` finish-bookkeeping rows). Blocks
+        until the device finishes that window — ideally while a later
+        window is already queued behind it (the overlap)."""
         import numpy as _np
 
         return _np.asarray(handle)
@@ -922,7 +936,7 @@ class PagedKVCache:
         self._spec_unharvested = [0] * self.slots
 
     def _device_window_dispatch(self, params, tokens, n_steps: int,
-                                active, steps_left):
+                                active, steps_left, stop_tokens):
         """Device seam: enqueue a capped greedy window (no read)."""
         import numpy as _np
 
@@ -932,6 +946,7 @@ class PagedKVCache:
             params, self.state, toks_in, self.cfg, n_steps,
             self._active_array(self.state, active),
             jnp.asarray(_np.asarray(steps_left, _np.int32)),
+            jnp.asarray(_np.asarray(stop_tokens, _np.int32)),
         )
         self._carry = (toks, n_steps)
         return toks
@@ -939,7 +954,8 @@ class PagedKVCache:
     def _device_window_sampled_dispatch(self, params, tokens,
                                         n_steps: int, active, key_data,
                                         base_steps, temps, top_ps,
-                                        sampled_mask, steps_left):
+                                        sampled_mask, steps_left,
+                                        stop_tokens):
         """Device seam: enqueue a capped mixed window (no read)."""
         import numpy as _np
 
@@ -954,6 +970,7 @@ class PagedKVCache:
             jnp.asarray(_np.asarray(top_ps, _np.float32)),
             jnp.asarray(_np.asarray(sampled_mask, bool)),
             jnp.asarray(_np.asarray(steps_left, _np.int32)),
+            jnp.asarray(_np.asarray(stop_tokens, _np.int32)),
         )
         self._carry = (toks, n_steps)
         return toks
@@ -1005,7 +1022,7 @@ class PagedKVCache:
     # ---- windowed speculative decode (device-resident passes) -----------
 
     def spec_window_caps(self, n_passes: int, k_len: int,
-                         budgets) -> "np.ndarray":
+                         budgets, sampled_mask=None) -> "np.ndarray":
         """Worst-case token advance per slot for ONE dispatched spec
         window: a row runs verify passes while its remaining budget is
         positive, each advancing 1 + accepted <= 1 + K, so the last
@@ -1013,18 +1030,32 @@ class PagedKVCache:
         the stream at harvest, exactly like the legacy per-pass path).
         Pages, host inflight accounting, and ``_spec_unharvested`` all
         reserve THIS bound; the true advance (the sum of the window's
-        acceptance counts) is only known at harvest."""
+        acceptance counts) is only known at harvest.
+
+        A SAMPLED row (``sampled_mask``) advances exactly one token per
+        live pass — acceptance is forced to 0 — so its cap is EXACT,
+        not a bound: ``min(budget, n_passes)``. Exactness matters
+        beyond page thrift: the serving layer prices ``base_steps`` for
+        the next pipelined window off inflight (= this cap), and the
+        sampler key schedule is only bit-identical to the per-pass path
+        when inflight equals the true advance.
+        """
         import numpy as _np
 
         budgets_np = _np.maximum(
             _np.asarray(budgets, _np.int64), 0
         ).astype(_np.int32)
         caps = _np.minimum(budgets_np + k_len, n_passes * (k_len + 1))
+        if sampled_mask is not None:
+            caps = _np.where(
+                _np.asarray(sampled_mask, bool),
+                _np.minimum(budgets_np, n_passes), caps,
+            )
         return _np.where(budgets_np > 0, caps, 0).astype(_np.int32)
 
     def dispatch_spec_window(self, params, tokens, n_passes: int,
                              k_len: int, budgets, active=None,
-                             ctx=None, ctx_len=None):
+                             ctx=None, ctx_len=None, sampling=None):
         """Enqueue ``n_passes`` speculative draft+verify passes in ONE
         device program, WITHOUT forcing the result.
 
@@ -1048,11 +1079,21 @@ class PagedKVCache:
         Page growth and ``_spec_unharvested`` reserve the worst case
         (:meth:`spec_window_caps`); host lengths advance only at
         harvest, by the true acceptance counts.
+
+        ``sampling`` (rung 23) carries a mixed batch's sampled
+        co-tenants through the SAME window: a ``(key_data, base_steps,
+        temps, top_ps, sampled_mask)`` tuple (the capped mixed
+        window's inputs) routes the dispatch through
+        :func:`_paged_spec_window_sampled_impl` — sampled rows ride
+        verify passes with acceptance 0 and draw their next token on
+        device; None keeps the greedy-only program.
         """
         import numpy as _np
 
         slots = self._step_slots(active)
-        caps = self.spec_window_caps(n_passes, k_len, budgets)
+        sampled_mask = sampling[4] if sampling is not None else None
+        caps = self.spec_window_caps(n_passes, k_len, budgets,
+                                     sampled_mask)
         budgets_np = _np.maximum(
             _np.asarray(budgets, _np.int64), 0
         ).astype(_np.int32)
@@ -1078,7 +1119,7 @@ class PagedKVCache:
             )
         emitted, counts, pend_out = self._device_spec_window(
             params, tokens, n_passes, k_len, active, budgets_np,
-            ctx, ctx_len,
+            ctx, ctx_len, sampling,
         )
         for slot in slots:
             if caps[slot] > 0:
@@ -1091,13 +1132,16 @@ class PagedKVCache:
         }
 
     def _device_spec_window(self, params, tokens, n_passes: int,
-                            k_len: int, active, budgets, ctx, ctx_len):
+                            k_len: int, active, budgets, ctx, ctx_len,
+                            sampling=None):
         """Device seam: enqueue a windowed spec program (no read).
         ``tokens=None`` rides the device-resident spec carry; the seam
         owns the carry resolution AND the carry update, so a slice
         override can broadcast the host inputs and keep a per-process
         carry (runtime/sliceserve.py) with the base host bookkeeping
-        unchanged."""
+        unchanged. The greedy and mixed programs share one carry triple
+        (pending, ctx, ctx_len), so a pipeline may hand a carry between
+        them when the batch's sampled population drains."""
         import numpy as _np
 
         if tokens is None:
@@ -1106,13 +1150,28 @@ class PagedKVCache:
             pending = jnp.asarray(_np.asarray(tokens, _np.int32))
             ctx_dev = jnp.asarray(_np.asarray(ctx, _np.int32))
             ctx_len_dev = jnp.asarray(_np.asarray(ctx_len, _np.int32))
-        (emitted, counts, pend_out, ctx_out, ctx_len_out,
-         self.state) = _paged_spec_window(
-            params, self.state, pending, self.cfg, n_passes, k_len,
-            self._active_array(self.state, active),
-            jnp.asarray(_np.asarray(budgets, _np.int32)), ctx_dev,
-            ctx_len_dev,
-        )
+        if sampling is None:
+            (emitted, counts, pend_out, ctx_out, ctx_len_out,
+             self.state) = _paged_spec_window(
+                params, self.state, pending, self.cfg, n_passes, k_len,
+                self._active_array(self.state, active),
+                jnp.asarray(_np.asarray(budgets, _np.int32)), ctx_dev,
+                ctx_len_dev,
+            )
+        else:
+            key_data, base_steps, temps, top_ps, sampled_mask = sampling
+            (emitted, counts, pend_out, ctx_out, ctx_len_out,
+             self.state) = _paged_spec_window_sampled(
+                params, self.state, pending, self.cfg, n_passes, k_len,
+                self._active_array(self.state, active),
+                jnp.asarray(_np.asarray(budgets, _np.int32)), ctx_dev,
+                ctx_len_dev,
+                jnp.asarray(_np.asarray(key_data, _np.uint32)),
+                jnp.asarray(_np.asarray(base_steps, _np.int32)),
+                jnp.asarray(_np.asarray(temps, _np.float32)),
+                jnp.asarray(_np.asarray(top_ps, _np.float32)),
+                jnp.asarray(_np.asarray(sampled_mask, bool)),
+            )
         self._spec_carry = (pend_out, ctx_out, ctx_len_out)
         return emitted, counts, pend_out
 
@@ -1628,6 +1687,96 @@ _paged_spec_window = functools.partial(
 )(_paged_spec_window_impl)
 
 
+def _paged_spec_window_sampled_impl(params: dict, state: PagedState,
+                                    tokens, cfg: TransformerConfig,
+                                    n_passes: int, k_len: int, active,
+                                    budgets, ctx, ctx_len, key_data,
+                                    base_steps, temps, top_ps,
+                                    sampled_mask):
+    """Mixed greedy/sampled :func:`_paged_spec_window_impl` — the
+    device-resident endgame for the sampled co-tenant (SERVING.md
+    rung 23): one sampled row no longer collapses the whole batch to
+    the legacy per-pass path.
+
+    Speculative sampling degenerates for this repo's greedy-verify
+    scheme: a sampled row's acceptance is forced to 0 (it rejects at
+    the first draft position), so "residual resampling on first
+    rejection" reduces to drawing the replacement token from the
+    nucleus-filtered target distribution at the PENDING position —
+    exactly what the legacy per-pass path does with
+    ``_sample_slots(logits0, ...)`` on the host. Here that draw moves
+    into the scan carry: ``spec_live = live & ~sampled_mask`` rides
+    :func:`_spec_verify_core` as the spec mask (acceptance 0, draft
+    K/V scatters dropped, length +1 per pass — the documented
+    sampled-row contract of the verify core), and the pending chain
+    for sampled rows feeds ``sample_token(logits0, fold_in(seed,
+    base + i), temp, top_p)`` instead of the bonus argmax.
+
+    The key schedule is bit-identical to the legacy path because a
+    live sampled row advances by EXACTLY one token per pass (counts
+    1 + accepted = 1), liveness is a prefix of the window (``rem``
+    only decreases), and the serving layer dispatches ``base_steps =
+    len(generated) + inflight + 1`` — so scan index ``i`` IS the
+    row's emitted offset, the same ``fold_in(seed, len(generated)+1)``
+    the per-pass path folds. ``emitted[p, b, 0]`` is patched to the
+    sampled draw so the harvest path reads sampled and greedy rows
+    through one code path (row b's pass-p count is 1: pending emits,
+    the sampled token is the next pending).
+    """
+    from kvedge_tpu.models.decode import sample_token
+    from kvedge_tpu.models.speculative import _propose_ngram
+
+    _note_trace("spec_window_sampled")
+    s_ctx = ctx.shape[1]
+    keys = jax.random.wrap_key_data(key_data)
+
+    def body(carry, i):
+        state, pending, rem, ctx, ctx_len = carry
+        live = active & (rem > 0)
+        spec_live = live & ~sampled_mask
+        draft = jax.vmap(
+            lambda c, n: _propose_ngram(c, n, k_len)
+        )(ctx, ctx_len)
+        toks = jnp.concatenate([pending[:, None], draft], axis=1)
+        emitted, accepted, logits0, state = _spec_verify_core(
+            params, state, toks, cfg, live, spec_live
+        )
+        step_keys = jax.vmap(jax.random.fold_in)(keys, base_steps + i)
+        sampled = sample_token(
+            logits0, step_keys, temps[:, None], top_ps[:, None]
+        )
+        count = live.astype(jnp.int32) * (1 + accepted)
+        bonus = jnp.take_along_axis(
+            emitted, accepted[:, None], axis=1
+        )[:, 0]
+        bonus = jnp.where(sampled_mask, sampled, bonus).astype(jnp.int32)
+        emitted = jnp.where(sampled_mask[:, None], bonus[:, None],
+                            emitted)
+        pending = jnp.where(live, bonus, pending)
+        idx = jnp.arange(k_len + 1)[None, :]
+        pos = ctx_len[:, None] + idx
+        ok = live[:, None] & (idx <= accepted[:, None])
+        pos = jnp.where(ok, pos, s_ctx)
+        ctx = jax.vmap(
+            lambda c, p, e: c.at[p].set(e, mode="drop")
+        )(ctx, pos, emitted)
+        ctx_len = ctx_len + count
+        rem = rem - count
+        return (state, pending, rem, ctx, ctx_len), (emitted, count)
+
+    carry0 = (state, tokens, budgets, ctx, ctx_len)
+    (state, pending, _rem, ctx, ctx_len), (emitted, counts) = (
+        jax.lax.scan(body, carry0, jnp.arange(n_passes))
+    )
+    return emitted, counts, pending, ctx, ctx_len, state
+
+
+_paged_spec_window_sampled = functools.partial(
+    jax.jit, static_argnames=("cfg", "n_passes", "k_len"),
+    donate_argnums=(1,),
+)(_paged_spec_window_sampled_impl)
+
+
 def _paged_decode_window_impl(params: dict, state: PagedState, tokens,
                               cfg: TransformerConfig, n_steps: int,
                               active):
@@ -1658,7 +1807,8 @@ _paged_decode_window = functools.partial(
 
 def _paged_decode_window_capped_impl(params: dict, state: PagedState,
                                      tokens, cfg: TransformerConfig,
-                                     n_steps: int, active, steps_left):
+                                     n_steps: int, active, steps_left,
+                                     stop_tokens):
     """Greedy window with PER-SLOT stop detection in the scan carry.
 
     The overlap pipeline (serving.py) dispatches window N+1 before the
@@ -1673,19 +1823,47 @@ def _paged_decode_window_capped_impl(params: dict, state: PagedState,
     seen yet. A frozen row keeps re-emitting its final token; the host
     truncates its stream at the true stop when it harvests
     (row b's real tokens are produced[:steps_left[b]]).
+
+    Finish bookkeeping rides the carry (SERVING.md rung 23):
+    ``stop_tokens`` [B] int32 is each row's stop token (-1 = none;
+    argmax can never produce -1, so stop-free traffic is bit-identical
+    by construction). The window tracks ``stop_at`` [B] — the first
+    1-based live step whose produced token equals the row's stop
+    (0 = no hit) — and the result packs TWO extra rows onto the
+    produced tokens: ``produced[n_steps] = fin`` (0 = window-capped,
+    1 = froze in-window on its per-slot cap, 2 = stop token hit) and
+    ``produced[n_steps + 1] = stop_at``. One device->host transfer
+    hands the host every finish decision, so the boundary sweep does
+    O(finishes) work instead of scanning the bucket. A stop hit does
+    NOT freeze the row on device — its remaining in-window steps decode
+    garbage within its already-granted cap (writes stay inside reserved
+    pages, lengths advance exactly as the host pre-booked) and the host
+    truncates the emission at ``stop_at``; the row's slot releases at
+    harvest, which zeroes the length either way.
     """
     _note_trace("window_capped")
 
     def body(carry, i):
-        state, toks = carry
+        state, toks, stop_at = carry
         live = active & (i < steps_left)
         logits, state = _decode_step_core(params, state, toks, cfg, live)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         nxt = jnp.where(live, nxt, toks)
-        return (state, nxt), nxt
+        stop_at = jnp.where(
+            live & (stop_at == 0) & (nxt == stop_tokens), i + 1, stop_at
+        )
+        return (state, nxt, stop_at), nxt
 
-    (state, _), produced = jax.lax.scan(
-        body, (state, tokens), jnp.arange(n_steps)
+    stop0 = jnp.zeros(tokens.shape[0], jnp.int32)
+    (state, _, stop_at), produced = jax.lax.scan(
+        body, (state, tokens, stop0), jnp.arange(n_steps)
+    )
+    fin = jnp.where(
+        stop_at > 0, 2,
+        jnp.where(active & (steps_left <= n_steps), 1, 0),
+    ).astype(jnp.int32)
+    produced = jnp.concatenate(
+        [produced, fin[None], stop_at[None]], axis=0
     )
     return produced, state
 
@@ -1698,18 +1876,21 @@ _paged_decode_window_capped = functools.partial(
 def _paged_decode_window_sampled_capped_impl(
         params: dict, state: PagedState, tokens,
         cfg: TransformerConfig, n_steps: int, active, key_data,
-        base_steps, temps, top_ps, sampled_mask, steps_left):
+        base_steps, temps, top_ps, sampled_mask, steps_left,
+        stop_tokens):
     """Mixed greedy/sampled window with the per-slot done flag of
     :func:`_paged_decode_window_capped_impl`. Live rows run the exact
     key schedule of the serial sampled window (``fold_in(seed,
     base + i)``), so pipelined and serial sampled decode emit identical
     tokens; frozen rows' draws are computed and discarded (their
-    outputs are never read and their state never advances)."""
+    outputs are never read and their state never advances). Packs the
+    same ``[fin, stop_at]`` finish-bookkeeping rows onto the produced
+    tokens as the greedy capped window."""
     _note_trace("window_sampled_capped")
     keys = jax.random.wrap_key_data(key_data)
 
     def body(carry, i):
-        state, toks = carry
+        state, toks, stop_at = carry
         live = active & (i < steps_left)
         logits, state = _decode_step_core(params, state, toks, cfg,
                                           live)
@@ -1722,10 +1903,21 @@ def _paged_decode_window_sampled_capped_impl(
         )
         nxt = jnp.where(sampled_mask, sampled, greedy).astype(jnp.int32)
         nxt = jnp.where(live, nxt, toks)
-        return (state, nxt), nxt
+        stop_at = jnp.where(
+            live & (stop_at == 0) & (nxt == stop_tokens), i + 1, stop_at
+        )
+        return (state, nxt, stop_at), nxt
 
-    (state, _), produced = jax.lax.scan(
-        body, (state, tokens), jnp.arange(n_steps)
+    stop0 = jnp.zeros(tokens.shape[0], jnp.int32)
+    (state, _, stop_at), produced = jax.lax.scan(
+        body, (state, tokens, stop0), jnp.arange(n_steps)
+    )
+    fin = jnp.where(
+        stop_at > 0, 2,
+        jnp.where(active & (steps_left <= n_steps), 1, 0),
+    ).astype(jnp.int32)
+    produced = jnp.concatenate(
+        [produced, fin[None], stop_at[None]], axis=0
     )
     return produced, state
 

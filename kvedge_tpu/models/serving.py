@@ -104,6 +104,20 @@ class _Request:
     # function of the request, so batch composition changes nothing.
     sampling: tuple | None = None
     next_token: int = -1
+    # Early-termination token (rung 23): generation finishes the moment
+    # this token is PRODUCED — it is emitted as the final token, then
+    # the request completes with its remaining budget unused. -1 (no
+    # stop token) can never match: every produced token id is >= 0, so
+    # stop-free traffic takes bit-identical paths with zero compares on
+    # device (the capped window kernels carry the per-row stop id and
+    # report the first hit in the packed finish rows).
+    stop_token: int = -1
+    # Device/host-detected stop whose finish had to be DEFERRED: the
+    # truncated stream (stop token last) is already emitted, but an
+    # in-flight window still touches this slot, so the slot and pages
+    # must survive until that window retires. The forced boundary's
+    # finish sweep completes it.
+    stopped: bool = False
     # Pages reserved at admission — stored on the request so release is
     # symmetric even if the server's spec mode changes mid-flight (the
     # auto guard rail can zero _spec; recomputing at release would then
@@ -227,6 +241,7 @@ class PagedGenerationServer:
                  pages: int = 64, page_size: int = 16,
                  prefill_chunk: int = 0, prefix_cache: bool = True,
                  speculative: int = 0, spec_window: int = 0,
+                 spec_sampled_window: bool = True,
                  window: int = 64,
                  kv_dtype: str = "", cache=None,
                  retry_after_s: float | None = None,
@@ -337,6 +352,40 @@ class PagedGenerationServer:
             )
         self._spec_window = int(spec_window)
         self._spec_windows = 0
+        # On-device sampled verify ([payload] serving_spec_sampled_window,
+        # SERVING.md rung 23): with the knob ON (default), a mixed
+        # greedy+sampled batch STAYS on the windowed spec path — sampled
+        # rows ride the verify scan advancing one token per pass with
+        # their positional fold_in keys split inside the scan, emitting
+        # the SAME tokens as the legacy per-pass path (pinned by tests).
+        # OFF restores the rung-20 behaviour (one sampled co-tenant
+        # collapses the batch to _spec_pass) and counts the collapse.
+        self._spec_sampled_window = bool(spec_sampled_window)
+        # Windowed-path collapses, labelled by cause (exported as
+        # spec_window_fallbacks_total{cause=...}): a spec window was
+        # configured but a boundary ran the legacy per-pass path
+        # anyway. "sampled" = mixed batch with the sampled-window knob
+        # off; "spec_off" = speculation disabled with a spec carry in
+        # flight; "overlap_off" = spec windows need the overlap
+        # pipeline but the serial loop is running.
+        self._spec_window_fallbacks = {
+            "sampled": 0, "spec_off": 0, "overlap_off": 0,
+        }
+        # Device-resident finish bookkeeping (rung 23): slots whose
+        # NEXT boundary sweep should examine them for completion —
+        # registered by every site that sets a pending token that
+        # completes a budget or matches a stop token, so the sweep does
+        # O(registered) work instead of scanning every active slot at
+        # bucket 256. The sweep re-validates each entry; dispatch loops
+        # re-register idle zero-budget rows as a self-healing backstop
+        # (a missed registration costs one extra window, never a hang).
+        self._finish_ready: set[int] = set()
+        # Stop-terminated rows whose finish is deferred until the
+        # window still touching their slot retires (_Request.stopped):
+        # a positive count forces the pipeline to a boundary, where the
+        # finish sweep completes them and zeroes this.
+        self._stops_pending = 0
+        self._stop_finishes = 0
         # Drafting-context capacity for the device-resident proposer:
         # prompt + generated + pending never exceeds max_seq + 1, and
         # the device appends at most K past the budget before freezing.
@@ -563,13 +612,23 @@ class PagedGenerationServer:
                timeout: float = 120.0, sampling: tuple | None = None,
                priority: str = "interactive",
                deadline_ms: int | None = None,
-               request_id: str = "") -> list[int]:
-        """Blocking generate: returns ``prompt + n_new`` tokens.
+               request_id: str = "",
+               stop_token: int | None = None) -> list[int]:
+        """Blocking generate: returns the prompt plus UP TO ``n_new``
+        generated tokens.
 
         Greedy unless ``sampling = (seed_key, temperature, top_p)`` —
         then token ``t`` samples with ``fold_in(seed_key, t)`` through
         the same nucleus filter as the contiguous backend, so the two
         produce identical tokens for identical requests.
+
+        ``stop_token`` ends generation early: the first produced
+        occurrence is emitted as the final token and the rest of the
+        budget goes unused (admission still reserves the worst case —
+        early stops return pages sooner, they never change capacity
+        semantics). Detection runs ON DEVICE inside the capped window
+        scans and comes back in the window's packed finish rows, so a
+        stop costs no extra host work per token.
 
         ``priority`` names the request's scheduling class
         (``interactive``/``batch``); ``deadline_ms`` optionally bounds
@@ -583,7 +642,8 @@ class PagedGenerationServer:
         req = self._start(prompt, n_new, timeout, sampling,
                           stream=False, priority=priority,
                           deadline_ms=deadline_ms,
-                          request_id=request_id)
+                          request_id=request_id,
+                          stop_token=stop_token)
         req.done.wait()
         if req.error is not None:
             raise req.error
@@ -594,7 +654,8 @@ class PagedGenerationServer:
                       sampling: tuple | None = None,
                       priority: str = "interactive",
                       deadline_ms: int | None = None,
-                      request_id: str = "") -> "StreamHandle":
+                      request_id: str = "",
+                      stop_token: int | None = None) -> "StreamHandle":
         """Streaming generate: an iterator yielding each generated token
         as it lands, with a ``cancel()`` method.
 
@@ -610,7 +671,8 @@ class PagedGenerationServer:
         req = self._start(prompt, n_new, timeout, sampling,
                           stream=True, priority=priority,
                           deadline_ms=deadline_ms,
-                          request_id=request_id)
+                          request_id=request_id,
+                          stop_token=stop_token)
         return StreamHandle(self, req)
 
     def cancel(self, req: _Request) -> None:
@@ -687,9 +749,12 @@ class PagedGenerationServer:
                sampling: tuple | None, stream: bool,
                priority: str = "interactive",
                deadline_ms: int | None = None,
-               request_id: str = "") -> _Request:
+               request_id: str = "",
+               stop_token: int | None = None) -> _Request:
         if not prompt or n_new < 1:
             raise ValueError("need a non-empty prompt and n_new >= 1")
+        if stop_token is not None and stop_token < 0:
+            raise ValueError("stop_token must be >= 0 (or None)")
         self._sched.rank(priority)  # unknown classes fail fast
         if deadline_ms is not None and deadline_ms < 1:
             raise ValueError("deadline_ms must be >= 1")
@@ -718,6 +783,7 @@ class PagedGenerationServer:
         tr = self.tracer
         req = _Request(
             prompt=list(prompt), n_new=n_new, sampling=sampling,
+            stop_token=-1 if stop_token is None else int(stop_token),
             pages_reserved=pages_needed,
             key_data=_raw_key_data(sampling[0]) if sampling else None,
             stream=queue.SimpleQueue() if stream else None,
@@ -888,6 +954,7 @@ class PagedGenerationServer:
                               "class": req.pclass},
                     )
                 self._active[slot] = req
+                self._note_finish_candidate_locked(slot, req)
                 self._prefilling -= 1
                 activated = True
                 # The fully-prefilled prompt's page-aligned prefixes
@@ -1554,6 +1621,28 @@ class PagedGenerationServer:
                      else "speculative" if not fallback
                      else "speculative (operator override)"),
         }
+        if self._spec_window > 0 and "spec_window_s" in t:
+            # Sampled co-tenant pricing (rung 23): a sampled row
+            # advances one token per pass on either path, so the
+            # choice is W host round trips (legacy _spec_pass) vs one
+            # (the windowed scan). Both rates are measured, not
+            # modelled — the same W-pass token count divided by W
+            # per-pass RTTs vs one windowed dispatch+harvest.
+            w = self._spec_window
+            legacy = 1 / t["verify_s"]
+            windowed_sampled = w / t["spec_window_s"]
+            decision["spec_window_ms"] = round(
+                t["spec_window_s"] * 1e3, 2
+            )
+            decision["sampled_cotenant_legacy_tokens_per_sec"] = (
+                round(legacy, 1)
+            )
+            decision["sampled_cotenant_windowed_tokens_per_sec"] = (
+                round(windowed_sampled, 1)
+            )
+            decision["sampled_window_pays"] = (
+                windowed_sampled >= legacy
+            )
         if fallback:
             action = ("falling back to windowed decode"
                       if auto else
@@ -1622,12 +1711,36 @@ class PagedGenerationServer:
                     active=active,
                 )
 
+            def run_spec_window():
+                # One full spec-window dispatch+harvest on slot 0 —
+                # the program the windowed sampled co-tenant rides, so
+                # its price is measured with the RTT amortization the
+                # rung-23 decision needs.
+                budgets = _np.zeros((n,), _np.int32)
+                budgets[0] = self._spec_window
+                ctx = _np.zeros((n, self._spec_ctx_cap), _np.int32)
+                ctx_len = _np.zeros((n,), _np.int32)
+                ctx_len[0] = 2  # prefilled token + pending
+                handle = self._cache.dispatch_spec_window(
+                    self._params, step_tokens, self._spec_window, k,
+                    budgets, ctx=ctx, ctx_len=ctx_len,
+                )
+                emitted, _, _ = self._cache.harvest_spec_window(handle)
+                self._cache.drop_carry()
+                return emitted
+
             timed(verify)  # compile + first-execution cost, untimed
             timed(run_window)
             verify_s = min(timed(verify) for _ in range(2))
             window_s = min(timed(run_window) for _ in range(2))
-        return {"verify_s": verify_s, "window_s": window_s,
-                "probed_window": window}
+            out = {"verify_s": verify_s, "window_s": window_s,
+                   "probed_window": window}
+            if self._spec_window > 0:
+                timed(run_spec_window)
+                out["spec_window_s"] = min(
+                    timed(run_spec_window) for _ in range(2)
+                )
+        return out
 
     def close(self, drain: bool = False) -> None:
         """Shut down. Hard close (default) poisons in-flight requests
@@ -1781,6 +1894,8 @@ class PagedGenerationServer:
             # carry — a revived pipeline restarts from host tokens
             # (a slice cache's reform() already dropped its own).
             self._inflight = None
+            self._finish_ready.clear()
+            self._stops_pending = 0
             self._cache.drop_carry()
             if self._cache.min_bucket:
                 # Restore the PRE-POISON rung (floored at what the
@@ -1847,6 +1962,9 @@ class PagedGenerationServer:
                 del req.generated[entry.gen_len:]
                 req.next_token = entry.next_token
                 req.inflight = 0
+                # A stop detected after the checkpoint is replay state:
+                # the rewound decode re-detects it bit-identically.
+                req.stopped = False
                 req.pages_reserved = entry.pages_reserved
                 req.ticket_no = entry.ticket_no
                 req.admit_seq = entry.admit_seq
@@ -1887,6 +2005,7 @@ class PagedGenerationServer:
             del req.generated[entry.gen_len:]
             req.next_token = entry.next_token
             req.inflight = 0
+            req.stopped = False
             self._sched.record_swapout_locked(
                 req, entry.pclass, entry.ticket_no,
                 entry.pages_reserved, entry.saved_len, entry.arrays,
@@ -1950,6 +2069,13 @@ class PagedGenerationServer:
                 "checkpoints_total": self._checkpoints_total,
                 "checkpoint_skipped_total": self._checkpoint_skipped,
                 "journal_restores_total": self._journal_restores,
+                # Device-resident endgame (SERVING.md rung 23):
+                # windowed-path collapses by cause (rendered as one
+                # labelled Prometheus counter) and stop-token finishes.
+                "spec_window_fallbacks": dict(
+                    self._spec_window_fallbacks
+                ),
+                "stop_finishes_total": self._stop_finishes,
             }
             if self.tracer is not None:
                 out.update(self.tracer.stats())
@@ -1976,6 +2102,9 @@ class PagedGenerationServer:
                 # logical passes per dispatch for the Perfetto view).
                 out["spec_window"] = self._spec_window
                 out["spec_windows_total"] = self._spec_windows
+                out["spec_window_sampled"] = (
+                    1 if self._spec_sampled_window else 0
+                )
                 out["spec_window_emitted_tokens"] = (
                     self._hist_spec_tokens.snapshot()
                 )
@@ -2097,21 +2226,33 @@ class PagedGenerationServer:
             if req.sampling is not None:
                 self._emit(req, req.next_token)
                 req.next_token = sampled_next[slot]
+                self._note_finish_candidate_locked(slot, req)
                 continue
             a = int(accepted[slot])
             room = req.n_new - len(req.generated)
             seq = [req.next_token] + [int(t) for t in emitted[slot, :a]]
+            emit_n, stopped = 0, False
             for t in seq[:room]:
                 self._emit(req, t)
-            self._spec_emitted += min(len(seq), room)
+                emit_n += 1
+                if t == req.stop_token:
+                    stopped = True
+                    break
+            self._spec_emitted += emit_n
             self._spec_slot_passes += 1
-            if len(req.generated) >= req.n_new:
+            if stopped:
+                # Passes run at boundaries only (nothing in flight):
+                # the stop finish never needs the deferred path.
+                self._stop_finishes += 1
+                self._finish_request_locked(slot, req)
+            elif len(req.generated) >= req.n_new:
                 self._finish_request_locked(slot, req)
             else:
                 # room > len(seq) here: room <= len(seq) means the
                 # request just filled its budget and took the finished
                 # branch above. The bonus token becomes pending.
                 req.next_token = int(emitted[slot, a])
+                self._note_finish_candidate_locked(slot, req)
 
     def _window_steps(self) -> int:
         """Steps the next device-side decode window may run (lock held).
@@ -2246,16 +2387,64 @@ class PagedGenerationServer:
                 req.stream.put(req.error)
             req.done.set()
 
+    def _note_finish_candidate_locked(self, slot: int,
+                                      req: _Request) -> None:
+        """Register a slot for the O(finishes) boundary sweep (lock
+        held): called by every site that installs a pending token
+        whose stepless emission would complete the request (budget
+        filled, stop token, or an already-stopped row awaiting its
+        deferred finish). The sweep re-validates, so a spurious
+        registration is one wasted lookup, never a wrong finish."""
+        if (req.stopped
+                or len(req.generated) + 1 >= req.n_new
+                or req.next_token == req.stop_token):
+            self._finish_ready.add(slot)
+
+    def _finish_stopped_locked(self, slot: int, req: _Request) -> None:
+        """Complete a stop-terminated row (lock held, truncated stream
+        already emitted with the stop token last). If an in-flight
+        window still touches this slot its pages are still being
+        scattered into on device — defer: mark the row stopped (later
+        harvests skip its emission), force a boundary via
+        ``_stops_pending``, and let the sweep finish it there."""
+        self._stop_finishes += 1
+        rec = self._inflight
+        if rec is not None and any(
+                s == slot for s, _, _ in rec["parts"]):
+            req.stopped = True
+            self._finish_ready.add(slot)
+            self._stops_pending += 1
+            return
+        self._finish_request_locked(slot, req)
+
     def _sweep_finished_locked(self) -> None:
-        """A request whose pending token completes its budget needs no
-        step at all (the token is already known) — finish it before
-        the batch, the same discipline as generate()'s n_new - 1
-        decode steps."""
-        for slot in list(self._active):
-            req = self._active[slot]
-            if len(req.generated) + 1 >= req.n_new:
+        """A request whose pending token completes its budget — or IS
+        its stop token — needs no step at all (the token is already
+        known): finish it before the batch, the same discipline as
+        generate()'s n_new - 1 decode steps. O(active-finishes), not
+        O(bucket): only slots registered in ``_finish_ready`` are
+        examined (rung 23 — at bucket 256 the per-boundary scan was
+        the last host cost scaling with slot count), and each entry is
+        re-validated against the live request before acting."""
+        for slot in sorted(self._finish_ready):
+            req = self._active.get(slot)
+            if req is None or req.cancelled:
+                continue
+            if req.stopped:
+                # Deferred stop finish: the truncated stream (stop
+                # token last) was emitted at harvest time.
+                self._finish_request_locked(slot, req)
+            elif len(req.generated) + 1 >= req.n_new:
                 self._emit(req, req.next_token)
                 self._finish_request_locked(slot, req)
+            elif req.next_token == req.stop_token:
+                self._emit(req, req.next_token)
+                self._stop_finishes += 1
+                self._finish_request_locked(slot, req)
+        self._finish_ready.clear()
+        # Every deferred stop finished (or was cancelled) above — this
+        # sweep IS the boundary _stops_pending forced.
+        self._stops_pending = 0
 
     # ---- scheduler boundary hooks (SERVING.md rung 17) -------------------
 
@@ -2370,6 +2559,7 @@ class PagedGenerationServer:
             # Active BEFORE the device calls: if the swap-in faults,
             # the poison path owns this waiter like any other.
             self._active[slot] = req
+            self._note_finish_candidate_locked(slot, req)
             self._cache.admit(slot, head.saved_len)
             self._cache.swapin_pages(
                 self._cache.slot_pages(slot), arrays
@@ -2474,6 +2664,10 @@ class PagedGenerationServer:
                     # passes (sampled slots ride along one token at a
                     # time); an all-sampled batch falls through to the
                     # cheaper single-query step below.
+                    if self._spec_window > 0:
+                        # Spec windows ride the overlap pipeline; the
+                        # serial loop can only run legacy passes.
+                        self._spec_window_fallbacks["overlap_off"] += 1
                     self._spec_pass()
                     return "ran"
                 # Feed every active slot's pending token through ONE
@@ -2523,11 +2717,29 @@ class PagedGenerationServer:
                                   "rows": len(self._active),
                                   "depth": 0},
                         )
-                    for slot, req in self._active.items():
+                    for slot, req in list(self._active.items()):
                         self._emit(req, req.next_token)
+                        finished = False
                         for i in range(window - 1):
-                            self._emit(req, int(produced[i, slot]))
-                        req.next_token = int(produced[window - 1, slot])
+                            t = int(produced[i, slot])
+                            self._emit(req, t)
+                            if t == req.stop_token:
+                                # Host-side stop truncation: the serial
+                                # window path touches every token here
+                                # anyway, so the uncapped kernels carry
+                                # no device-side stop rows. Nothing is
+                                # in flight — finish immediately.
+                                self._stop_finishes += 1
+                                self._finish_request_locked(slot, req)
+                                finished = True
+                                break
+                        if not finished:
+                            req.next_token = int(
+                                produced[window - 1, slot]
+                            )
+                            self._note_finish_candidate_locked(
+                                slot, req
+                            )
                     return "ran"
                 t0 = time.perf_counter()
                 logits = self._cache.step(
@@ -2542,6 +2754,7 @@ class PagedGenerationServer:
                 for slot, req in self._active.items():
                     self._emit(req, req.next_token)
                     req.next_token = next_tokens[slot]
+                    self._note_finish_candidate_locked(slot, req)
             except Exception as e:  # poison: fail every waiter loudly
                 # Typed poisoning (runtime/failures.py): an already-
                 # typed failure (e.g. SliceFollowerLost from the op
@@ -2625,23 +2838,33 @@ class PagedGenerationServer:
                     if (self._spec > 0
                             and any(req.sampling is None
                                     for req in self._active.values())):
+                        all_greedy = all(
+                            req.sampling is None
+                            for req in self._active.values()
+                        )
                         if (self._spec_window > 0
-                                and all(req.sampling is None
-                                        for req in
-                                        self._active.values())):
+                                and (all_greedy
+                                     or self._spec_sampled_window)):
                             # Device-resident spec windows: draft +
                             # verify + accept/reject run IN the
                             # dispatched scan, so spec mode joins the
                             # double-buffered pipeline instead of
-                            # forcing a boundary per pass.
+                            # forcing a boundary per pass. Sampled
+                            # co-tenants ride the scan too (rung 23,
+                            # knob-gated): one token per pass with
+                            # their positional keys split on device.
                             self._inflight = (
                                 self._dispatch_spec_window_locked(
                                     first=True
                                 )
                             )
                             return "ran"
-                        # Legacy per-pass speculation (or a sampled
-                        # co-tenant in the batch): drafting reads
+                        if self._spec_window > 0:
+                            # Mixed batch with the sampled-window knob
+                            # off: the one remaining windowed-path
+                            # collapse, now counted instead of silent.
+                            self._spec_window_fallbacks["sampled"] += 1
+                        # Legacy per-pass speculation: drafting reads
                         # emitted tokens on the host, so passes run at
                         # boundaries only and never overlap.
                         self._spec_pass()
@@ -2660,7 +2883,8 @@ class PagedGenerationServer:
                         # the SAME carry kind as the previous one
                         # (plain and spec carries are separate device
                         # state); a kind change joins at a boundary.
-                        if prev.get("kind") != "spec":
+                        if prev.get("kind") not in ("spec",
+                                                    "spec_sampled"):
                             self._inflight = (
                                 self._dispatch_window_locked(
                                     first=False
@@ -2668,19 +2892,29 @@ class PagedGenerationServer:
                             )
                         elif (self._spec > 0
                               and self._spec_window > 0):
+                            # Kind-matched redispatch: both spec kinds
+                            # share the device spec carry (pending +
+                            # drafting context), so a mixed pipeline
+                            # whose sampled rows all finished simply
+                            # redispatches as plain "spec" on the same
+                            # carry.
                             self._inflight = (
                                 self._dispatch_spec_window_locked(
                                     first=False
                                 )
                             )
-                        # else: speculation was disabled with a spec
-                        # window in flight — collapse to a boundary.
+                        else:
+                            # Speculation was disabled with a spec
+                            # window in flight — collapse to a
+                            # boundary (counted: the next boundary
+                            # runs the non-windowed path).
+                            self._spec_window_fallbacks["spec_off"] += 1
                     elif self.tracer is not None:
                         # Overlap boundary: the pipeline collapses so a
                         # cancel/newcomer/swap can join reconciled.
                         self.tracer.event("boundary", "serve",
                                           args={"reason": "reconcile"})
-                    if prev.get("kind") == "spec":
+                    if prev.get("kind") in ("spec", "spec_sampled"):
                         self._harvest_spec_window_locked(prev)
                     else:
                         self._harvest_locked(prev)
@@ -2719,6 +2953,7 @@ class PagedGenerationServer:
         # needs a real boundary every ``checkpoint_every`` windows, so
         # the due clock forces the collapse the checkpoint rides.
         return (self._bucket_step_wanted
+                or self._stops_pending > 0
                 or (self._checkpoint_every > 0
                     and self._ckpt_clock >= self._checkpoint_every)
                 or self._sched_attention_locked(ignore_inflight=True))
@@ -2755,8 +2990,15 @@ class PagedGenerationServer:
         parts = []
         for slot, req in self._active.items():
             cap = req.n_new - len(req.generated) - req.inflight - 1
-            if cap > 0:
+            if cap > 0 and not req.stopped:
                 parts.append((slot, req, cap))
+            elif req.inflight == 0:
+                # Self-healing backstop for the O(finishes) sweep:
+                # this loop is already O(active), so re-registering an
+                # idle zero-budget (or stop-terminated) row costs
+                # nothing and bounds a missed registration at one
+                # extra iteration.
+                self._finish_ready.add(slot)
         if not parts:
             return None
         # The widest remaining budget sets the window (pow2-floored,
@@ -2770,12 +3012,14 @@ class PagedGenerationServer:
         tokens = np.zeros((n,), np.int32)
         mask = np.zeros((n,), bool)
         steps_left = np.zeros((n,), np.int32)
+        stop_tokens = np.full((n,), -1, np.int32)
         recs = []
         for slot, req, cap in parts:
             adv = min(w, cap)
             tokens[slot] = req.next_token
             mask[slot] = True
             steps_left[slot] = adv
+            stop_tokens[slot] = req.stop_token
             recs.append((slot, req, adv))
         samplers = {slot: req for slot, req, _ in parts
                     if req.sampling is not None}
@@ -2802,11 +3046,12 @@ class PagedGenerationServer:
             handle = self._cache.dispatch_window_sampled(
                 self._params, tok_arg, w, mask, key_data, base_steps,
                 temps, top_ps, smask, steps_left=steps_left,
+                stop_tokens=stop_tokens,
             )
         else:
             handle = self._cache.dispatch_window(
                 self._params, tok_arg, w, active=mask,
-                steps_left=steps_left,
+                steps_left=steps_left, stop_tokens=stop_tokens,
             )
         for _, req, adv in recs:
             req.inflight += adv
@@ -2838,11 +3083,34 @@ class PagedGenerationServer:
         self._ckpt_clock += 1  # window of progress at risk (rung 22)
         for _, req, adv in rec["parts"]:
             req.inflight -= adv
+        w = rec["window"]
         for slot, req, adv in rec["parts"]:
-            if self._active.get(slot) is not req:
+            if self._active.get(slot) is not req or req.stopped:
                 # Released while in flight (hard-close/cancel races
-                # resolve at boundaries, so normally unreachable) —
-                # nothing to emit into.
+                # resolve at boundaries, so normally unreachable), or
+                # stop-terminated at an earlier harvest with its
+                # finish deferred — nothing to emit into.
+                continue
+            # Device-resident finish bookkeeping (rung 23): rows
+            # n_steps and n_steps+1 of the harvested block are the
+            # packed per-slot finish reason (0 window-capped /
+            # 1 budget-frozen / 2 stop) and the 1-based step of the
+            # first stop hit — ONE transfer carries tokens and
+            # bookkeeping both, and the host never compares per-token.
+            stop_at = int(produced[w + 1, slot])
+            if 0 < stop_at and not req.cancelled:
+                # Emit the pending token plus everything up to AND
+                # INCLUDING the stop token, then finish; steps past
+                # the stop decoded garbage inside the granted cap and
+                # are discarded (the slot releases, so the device-side
+                # over-advance is moot).
+                room = req.n_new - len(req.generated)
+                seq = [req.next_token] + [
+                    int(produced[i, slot]) for i in range(stop_at)
+                ]
+                for t in seq[:room]:
+                    self._emit(req, t)
+                self._finish_stopped_locked(slot, req)
                 continue
             self._emit(req, req.next_token)
             for i in range(adv - 1):
@@ -2879,6 +3147,17 @@ class PagedGenerationServer:
         worst-case cap (``min(budget + K, W*(1+K))``); the true
         advance lands at harvest, truncated at the budget exactly like
         the legacy per-pass path's room cap.
+
+        SAMPLED rows (rung 23, ``spec_sampled_window``) join the same
+        window: the scan advances them exactly one token per live pass
+        with on-device ``fold_in(seed, base + i)`` keys, so their cap
+        is EXACT (``min(budget, W)`` — kvcache.spec_window_caps) and
+        ``base = len(generated) + inflight + 1`` reproduces the legacy
+        per-pass schedule bit-identically even across pipelined
+        redispatches. The record's kind is ``"spec_sampled"`` when any
+        sampled row rides (``"spec"`` otherwise); both kinds share the
+        device spec carry, so kind-matched redispatch treats them as
+        one family.
         """
         k = self._spec
         w = self._spec_window
@@ -2887,11 +3166,37 @@ class PagedGenerationServer:
         parts = []
         for slot, req in self._active.items():
             room = req.n_new - len(req.generated) - req.inflight
-            if room > 0:
+            if room > 0 and not req.stopped:
                 budgets[slot] = room
                 parts.append((slot, req))
+            elif req.inflight == 0:
+                # Same self-healing backstop as the plain dispatch.
+                self._finish_ready.add(slot)
         if not parts:
             return None
+        samplers = {slot: req for slot, req in parts
+                    if req.sampling is not None}
+        sampling = None
+        if samplers:
+            key_data = np.zeros(
+                (n,) + self._key_data_shape(samplers), np.uint32
+            )
+            base_steps = np.zeros((n,), np.int32)
+            temps = np.ones((n,), np.float32)
+            top_ps = np.ones((n,), np.float32)
+            smask = np.zeros((n,), bool)
+            for slot, req in samplers.items():
+                key_data[slot] = req.key_data
+                # Committed position, as in the plain sampled window:
+                # token t samples with fold_in(seed, t) regardless of
+                # pipelining, because a sampled row's in-window advance
+                # is exactly its cap (1 token per live pass).
+                base_steps[slot] = (len(req.generated)
+                                    + req.inflight + 1)
+                temps[slot] = float(req.sampling[1])
+                top_ps[slot] = float(req.sampling[2])
+                smask[slot] = True
+            sampling = (key_data, base_steps, temps, top_ps, smask)
         if first:
             ctx = np.zeros((n, self._spec_ctx_cap), np.int32)
             ctx_len = np.zeros((n,), np.int32)
@@ -2903,11 +3208,11 @@ class PagedGenerationServer:
                 tokens[slot] = req.next_token
             handle = self._cache.dispatch_spec_window(
                 self._params, tokens, w, k, budgets,
-                ctx=ctx, ctx_len=ctx_len,
+                ctx=ctx, ctx_len=ctx_len, sampling=sampling,
             )
         else:
             handle = self._cache.dispatch_spec_window(
-                self._params, None, w, k, budgets
+                self._params, None, w, k, budgets, sampling=sampling,
             )
         recs = []
         for slot, req in parts:
@@ -2915,7 +3220,8 @@ class PagedGenerationServer:
             req.inflight += cap
             recs.append((slot, req, cap))
         self._hist_depth.observe(0.0 if first else 1.0)
-        return {"kind": "spec", "window": w, "parts": recs,
+        return {"kind": "spec_sampled" if samplers else "spec",
+                "window": w, "parts": recs,
                 "handle": handle, "depth": 0 if first else 1,
                 "t0": time.perf_counter()}
 
@@ -2947,11 +3253,14 @@ class PagedGenerationServer:
             req.inflight -= cap
         self._spec_passes += rec["window"]
         for slot, req, cap in rec["parts"]:
-            if self._active.get(slot) is not req:
+            if self._active.get(slot) is not req or req.stopped:
                 # Released while in flight (normally unreachable —
-                # cancels resolve at boundaries); nothing to emit into.
+                # cancels resolve at boundaries) or stop-terminated at
+                # an earlier harvest awaiting its deferred finish;
+                # nothing to emit into.
                 continue
             before = len(req.generated)
+            stopped = False
             for p in range(rec["window"]):
                 c = int(counts[p, slot])
                 if c == 0:
@@ -2959,22 +3268,48 @@ class PagedGenerationServer:
                     # (rem <= 0) — no tokens, no pending advance.
                     continue
                 room = max(req.n_new - len(req.generated), 0)
+                # Sampled rows advance exactly one token per pass
+                # (c == 1): seq is just the pending token and the
+                # device-sampled token becomes the next pending —
+                # the legacy _spec_pass semantics, scanned.
                 seq = [req.next_token] + [
                     int(t) for t in emitted[p, slot, :c - 1]
                 ]
+                emit_n = 0
                 for t in seq[:room]:
                     self._emit(req, t)
+                    emit_n += 1
+                    if t == req.stop_token:
+                        # Host-side stop truncation (the harvest
+                        # touches every token anyway): later passes
+                        # decoded garbage and are discarded.
+                        stopped = True
+                        break
                 req.next_token = int(emitted[p, slot, c - 1])
-                self._spec_emitted += min(len(seq), room)
-                self._spec_slot_passes += 1
+                if req.sampling is None:
+                    # Greedy acceleration stats only — sampled rows
+                    # ride at one token per pass by construction and
+                    # would drag the realized-acceptance gauge down.
+                    self._spec_emitted += emit_n
+                    self._spec_slot_passes += 1
+                if stopped:
+                    break
             self._hist_spec_tokens.observe(
                 float(len(req.generated) - before)
             )
-            if len(req.generated) >= req.n_new and not req.cancelled:
+            if stopped and not req.cancelled:
+                self._finish_stopped_locked(slot, req)
+            elif (len(req.generated) >= req.n_new
+                    and not req.cancelled):
                 # Inline finish, as in the plain harvest: a saturated
                 # pipeline may never visit a boundary. The cancelled
                 # guard preserves cancel-beats-finish ordering.
                 self._finish_request_locked(slot, req)
+            else:
+                # The carried pending may itself be the stop token (a
+                # sampled row's device-sampled next, or a bonus token):
+                # register it for the boundary sweep.
+                self._note_finish_candidate_locked(slot, req)
         self._spec_windows += 1
         self._overlap_windows += 1
         self._hist_host.observe((time.perf_counter() - t_host) * 1e3)
@@ -2992,7 +3327,7 @@ class PagedGenerationServer:
             for _, req, adv in rec["parts"]:
                 req.inflight -= adv
         try:
-            if rec.get("kind") == "spec":
+            if rec.get("kind") in ("spec", "spec_sampled"):
                 self._cache.harvest_spec_window(rec["handle"])
             else:
                 self._cache.harvest_window(rec["handle"])

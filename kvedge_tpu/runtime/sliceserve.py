@@ -74,6 +74,7 @@ from kvedge_tpu.models.kvcache import (
     _paged_decode_window_sampled_impl,
     _paged_prefill_impl,
     _paged_spec_window_impl,
+    _paged_spec_window_sampled_impl,
     _scatter_pages_impl,
     _spec_verify_core,
 )
@@ -86,7 +87,7 @@ from kvedge_tpu.models.kvcache import (
 # at the end: the numbering is wire protocol.
 (OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW, OP_SPEC,
  OP_WSAMPLE, OP_WINDOWP, OP_WSAMPLEP, OP_SWAPOUT, OP_SWAPIN,
- OP_SPECW) = range(12)
+ OP_SPECW, OP_SPECWS, OP_MULTI) = range(14)
 _HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
 
 # Human names for follower-side replay spans (runtime/tracing.py).
@@ -95,8 +96,19 @@ _OP_NAMES = {
     OP_STEP: "step", OP_WINDOW: "window", OP_SPEC: "spec",
     OP_WSAMPLE: "wsample", OP_WINDOWP: "windowp",
     OP_WSAMPLEP: "wsamplep", OP_SWAPOUT: "swapout",
-    OP_SWAPIN: "swapin", OP_SPECW: "specw",
+    OP_SWAPIN: "swapin", OP_SPECW: "specw", OP_SPECWS: "specws",
+    OP_MULTI: "multi",
 }
+
+# Ops whose payloads may ride a coalesced OP_MULTI frame (SERVING.md
+# rung 23): the deferred table sync and swap-in that precede a window
+# dispatch at a page boundary, plus the pipelined dispatches
+# themselves. Every one of these has payload shapes fully derivable
+# from its own [op, a, b, c] header, which is what lets the follower
+# carve a packed frame without any out-of-band shape agreement.
+_COALESCABLE = frozenset((
+    OP_SYNC, OP_SWAPIN, OP_WINDOWP, OP_WSAMPLEP, OP_SPECW, OP_SPECWS,
+))
 
 
 def _slice_kernels(mesh, cfg, quantized: bool = False):
@@ -172,6 +184,16 @@ def _slice_kernels(mesh, cfg, quantized: bool = False):
         donate_argnums=(1,),
         out_shardings=(rep, rep, rep, rep, rep, state_sh),
     )
+    # Mixed greedy/sampled spec window (SERVING.md rung 23): same
+    # carry triple and output shardings as the greedy program — the
+    # two share one device-resident carry, so a pipeline may hand the
+    # carry between them when the batch's sampled population drains.
+    specws = jax.jit(
+        _paged_spec_window_sampled_impl,
+        static_argnames=("cfg", "n_passes", "k_len"),
+        donate_argnums=(1,),
+        out_shardings=(rep, rep, rep, rep, rep, state_sh),
+    )
     # Preemptive swap (SERVING.md rung 17): the gather pins REPLICATED
     # outputs — an all-gather over the model-sharded pool dims, so the
     # leader can host-read the as-stored page bytes; the scatter takes
@@ -184,7 +206,7 @@ def _slice_kernels(mesh, cfg, quantized: bool = False):
     )
     return (rep, state_sh, prefill, step, window, spec, wsample,
             window_capped, wsample_capped, swap_gather, swap_scatter,
-            specw)
+            specw, specws)
 
 
 class SlicePagedKVCache(PagedKVCache):
@@ -223,11 +245,20 @@ class SlicePagedKVCache(PagedKVCache):
          self._k_window, self._k_spec, self._k_wsample,
          self._k_window_capped, self._k_wsample_capped,
          self._k_swapout, self._k_swapin,
-         self._k_specw) = _slice_kernels(
+         self._k_specw, self._k_specws) = _slice_kernels(
              mesh, cfg, quantized=kv_dtype == "int8"
          )
         self._is_leader = jax.process_index() == 0
         self._stopped = False
+        # Coalesced slice broadcasts (SERVING.md rung 23): leader-side
+        # buffer of (header, payload, exec) triples for ops whose
+        # broadcast may be deferred to the next dispatch seam, where
+        # everything pending goes out as ONE framed OP_MULTI — a table
+        # sync or swap-in at a page boundary no longer pays its own
+        # pair of collectives. Counters are plain observability.
+        self._pending_ops: list = []
+        self.coalesced_flushes = 0
+        self.coalesced_ops = 0
         # Leader-side watchdog over the op stream (header send,
         # broadcast, exec): a wedged collective surfaces as a typed
         # SliceFollowerLost instead of an eternal hang holding the
@@ -318,6 +349,142 @@ class SlicePagedKVCache(PagedKVCache):
         hdr = np.array([op, a, b, c], np.int64)
         self._bcast(hdr)
 
+    # ---- coalesced multi-op broadcasts (SERVING.md rung 23) --------------
+
+    def _queue_op(self, hdr: tuple, payload: tuple, exec_thunk) -> None:
+        """Buffer one coalescable op. The payload arrays MUST be
+        snapshots (never views of live host bookkeeping): the
+        broadcast is deferred to the next flush, and the serving layer
+        keeps mutating ``_host_tables``/``_host_lengths`` in between."""
+        self._pending_ops.append((
+            np.array(hdr, np.int64),
+            tuple(np.ascontiguousarray(a) for a in payload),
+            exec_thunk,
+        ))
+
+    def _flush_ops(self, key: tuple | None = None,
+                   budget_s: float | None = None):
+        """Broadcast + execute everything pending, in queue order.
+
+        One buffered op goes out exactly as it always did — its own
+        header + payload pair, wire-identical to the pre-coalescing
+        protocol. Two or more pack into a single OP_MULTI frame: one
+        header (a = op count, b = frame bytes) and ONE uint8 payload
+        broadcast carrying each op's [op, a, b, c] header followed by
+        its raw array bytes; the follower re-derives every shape from
+        the embedded headers (:meth:`_multi_templates`) and replays
+        through the same exec path as the bare branches. Execution
+        (leader-side jit enqueue) happens AFTER the frame broadcast,
+        in op order, so the collective order every process sees is
+        identical to the unbatched stream. Returns the LAST op's exec
+        result (the dispatch that forced the flush)."""
+        if not self._pending_ops:
+            return None
+        ops, self._pending_ops = self._pending_ops, []
+        if key is None:
+            key = ("multi", len(ops))
+
+        if len(ops) == 1:
+            hdr, payload, exec_thunk = ops[0]
+
+            def op():
+                self._bcast(hdr)
+                self._bcast(payload)
+                return exec_thunk()
+
+            return self._traced_run(key, op, budget_s=budget_s)
+
+        frame = np.frombuffer(
+            b"".join(
+                hdr.tobytes() + b"".join(a.tobytes() for a in payload)
+                for hdr, payload, _ in ops
+            ),
+            np.uint8,
+        )
+
+        def op():
+            self._send_header(OP_MULTI, len(ops), frame.shape[0])
+            self._bcast(frame)
+            out = None
+            for _, _, exec_thunk in ops:
+                out = exec_thunk()
+            return out
+
+        self.coalesced_flushes += 1
+        self.coalesced_ops += len(ops)
+        return self._traced_run(key, op, budget_s=budget_s)
+
+    def _discard_pending_ops(self) -> None:
+        """Drop buffered ops without broadcasting (stop/reform): the
+        followers are released or rejoining at a barrier SYNC that
+        re-syncs tables anyway — replaying onto a dead or reset stream
+        would wedge or double-apply."""
+        self._pending_ops.clear()
+
+    def _multi_templates(self, op: int, a: int, b: int, c: int) -> tuple:
+        """(shape, dtype) per payload array for a coalescable op, as a
+        pure function of its header — the single source of truth for
+        both the bare zero-template broadcasts and OP_MULTI frame
+        carving, so the two wire forms can never drift apart."""
+        n = self.slots
+        if op == OP_SYNC:
+            return (((n, self.max_pages_per_seq), np.int32),
+                    ((n,), np.int32))
+        if op == OP_SWAPIN:
+            return tuple(
+                (arr.shape, arr.dtype) for arr in self._swap_templates(a)
+            )
+        if op == OP_WINDOWP:
+            # a = n_steps, b = carry flag.
+            return (((n,), np.int32), ((n,), bool), ((n,), np.int32),
+                    ((n,), np.int32))
+        if op == OP_WSAMPLEP:
+            # a = n_steps, b = key-data width, c = carry flag.
+            return (((n,), np.int32), ((n,), bool), ((n, b), np.uint32),
+                    ((n,), np.int32), ((n,), np.float32),
+                    ((n,), np.float32), ((n,), bool), ((n,), np.int32),
+                    ((n,), np.int32))
+        if op == OP_SPECW:
+            # a = n_passes, b = k_len, c = ctx width (0 = carry).
+            width = c if c > 0 else 1
+            return (((n,), np.int32), ((n,), bool), ((n,), np.int32),
+                    ((n, width), np.int32), ((n,), np.int32))
+        if op == OP_SPECWS:
+            # a = n_passes, b = k_len * 256 + key-data width,
+            # c = ctx width (0 = carry).
+            kw = b % 256
+            width = c if c > 0 else 1
+            return (((n,), np.int32), ((n,), bool), ((n,), np.int32),
+                    ((n, width), np.int32), ((n,), np.int32),
+                    ((n, kw), np.uint32), ((n,), np.int32),
+                    ((n,), np.float32), ((n,), np.float32), ((n,), bool))
+        raise PagedCacheError(f"op {op} is not coalescable")
+
+    def _replay_packed(self, params, op: int, a: int, b: int, c: int,
+                       payload: list) -> None:
+        """Follower: replay one coalescable op through the SAME exec
+        seams the bare branches use — a frame-carried op and a bare op
+        are indistinguishable past this point."""
+        if op == OP_SYNC:
+            self._apply_sync(payload[0], payload[1])
+        elif op == OP_SWAPIN:
+            self._exec_swapin(payload[0], tuple(payload[1:]))
+        elif op == OP_WINDOWP:
+            self._exec_window_pipelined(
+                params, *payload, n_steps=a, carry=bool(b))
+        elif op == OP_WSAMPLEP:
+            self._exec_window_sampled_pipelined(
+                params, *payload, n_steps=a, carry=bool(c))
+        elif op == OP_SPECW:
+            self._exec_spec_window(
+                params, *payload, n_passes=a, k_len=b, carry=c == 0)
+        elif op == OP_SPECWS:
+            self._exec_spec_window(
+                params, *payload, n_passes=a, k_len=b // 256,
+                carry=c == 0)
+        else:  # pragma: no cover - _multi_templates already refused
+            raise PagedCacheError(f"op {op} is not coalescable")
+
     # ---- leader-side device seams (base-class host logic unchanged) -----
 
     def _traced_run(self, key: tuple, op, budget_s: float | None = None):
@@ -351,15 +518,18 @@ class SlicePagedKVCache(PagedKVCache):
             # device state is dead, so the host bookkeeping proceeds
             # without a broadcast.
             return
-        tables = np.asarray(self._host_tables, np.int32)
-        lengths = np.asarray(self._host_lengths, np.int32)
-
-        def op():
-            self._send_header(OP_SYNC)
-            return self._bcast((tables, lengths))
-
-        tables, lengths = self._traced_run(("sync",), op)
-        self._apply_sync(np.asarray(tables), np.asarray(lengths))
+        # Deferred (rung 23): the broadcast rides the next flush — at
+        # a page boundary that is the window dispatch a moment later,
+        # so sync + dispatch go out as ONE OP_MULTI frame instead of
+        # two header/payload collective pairs. np.array COPIES: the
+        # serving layer mutates the host tables between queue and
+        # flush, and the wire must carry this call's snapshot.
+        tables = np.array(self._host_tables, np.int32)
+        lengths = np.array(self._host_lengths, np.int32)
+        self._queue_op(
+            (OP_SYNC, 0, 0, 0), (tables, lengths),
+            lambda: self._apply_sync(tables, lengths),
+        )
 
     def _apply_sync(self, tables: np.ndarray, lengths: np.ndarray):
         import dataclasses
@@ -384,6 +554,7 @@ class SlicePagedKVCache(PagedKVCache):
 
     def _device_prefill(self, params, tokens, slot: int, offset: int):
         self._check_live()
+        self._flush_ops()
         tokens = np.asarray(tokens, np.int32)
 
         def op():
@@ -411,6 +582,7 @@ class SlicePagedKVCache(PagedKVCache):
 
     def _device_step(self, params, tokens, active):
         self._check_live()
+        self._flush_ops()
         tokens = np.asarray(tokens, np.int32)
         mask = self._active_np(active)
 
@@ -431,6 +603,7 @@ class SlicePagedKVCache(PagedKVCache):
 
     def _device_window(self, params, tokens, n_steps: int, active):
         self._check_live()
+        self._flush_ops()
         tokens = np.asarray(tokens, np.int32)
         mask = self._active_np(active)
 
@@ -454,6 +627,7 @@ class SlicePagedKVCache(PagedKVCache):
                                active, key_data, base_steps, temps,
                                top_ps, sampled_mask):
         self._check_live()
+        self._flush_ops()
         tokens = np.asarray(tokens, np.int32)
         key_data = np.asarray(key_data, np.uint32)
         mask = self._active_np(active)
@@ -491,33 +665,37 @@ class SlicePagedKVCache(PagedKVCache):
     # ---- pipelined (overlap) window pair --------------------------------
 
     def _device_window_dispatch(self, params, tokens, n_steps: int,
-                                active, steps_left):
+                                active, steps_left, stop_tokens):
         """Leader: broadcast + enqueue a capped window WITHOUT reading
         the result. ``tokens=None`` selects the device-resident carry
         (header flag ``b``) — the previous window's final token row,
         which every process slices locally from its own replicated
         copy, so neither the leader nor any follower blocks on the
         previous window between the pair. A zero placeholder still
-        rides the broadcast so the payload shape is op-independent."""
+        rides the broadcast so the payload shape is op-independent.
+        The dispatch is a flush seam (rung 23): a buffered table sync
+        rides the same framed broadcast."""
         self._check_live()
         carry = 0 if tokens is not None else 1
         tokens_np = (np.zeros((self.slots,), np.int32) if carry
                      else np.asarray(tokens, np.int32))
         mask = self._active_np(active)
         caps = np.asarray(steps_left, np.int32)
+        stops = np.asarray(stop_tokens, np.int32)
 
-        def op():
-            self._send_header(OP_WINDOWP, n_steps, carry)
-            sent, m, sl = self._bcast((tokens_np, mask, caps))
-            return self._exec_window_pipelined(
-                params, np.asarray(sent), np.asarray(m),
-                np.asarray(sl), n_steps=n_steps, carry=bool(carry),
-            )
-
-        return self._traced_run(("windowp", n_steps), op)
+        self._queue_op(
+            (OP_WINDOWP, n_steps, carry, 0),
+            (tokens_np, mask, caps, stops),
+            lambda: self._exec_window_pipelined(
+                params, tokens_np, mask, caps, stops,
+                n_steps=n_steps, carry=bool(carry),
+            ),
+        )
+        return self._flush_ops(("windowp", n_steps))
 
     def _exec_window_pipelined(self, params, tokens: np.ndarray,
-                               mask: np.ndarray, caps: np.ndarray, *,
+                               mask: np.ndarray, caps: np.ndarray,
+                               stops: np.ndarray, *,
                                n_steps: int, carry: bool):
         toks_in = (self._carry_tokens() if carry
                    else self._global(tokens.astype(np.int32)))
@@ -525,6 +703,7 @@ class SlicePagedKVCache(PagedKVCache):
             params, self.state, toks_in, self.cfg, n_steps,
             self._global(mask.astype(bool)),
             self._global(caps.astype(np.int32)),
+            self._global(stops.astype(np.int32)),
         )
         self._carry = (toks, n_steps)
         return toks
@@ -532,36 +711,36 @@ class SlicePagedKVCache(PagedKVCache):
     def _device_window_sampled_dispatch(self, params, tokens,
                                         n_steps: int, active, key_data,
                                         base_steps, temps, top_ps,
-                                        sampled_mask, steps_left):
+                                        sampled_mask, steps_left,
+                                        stop_tokens):
         self._check_live()
         carry = 0 if tokens is not None else 1
         tokens_np = (np.zeros((self.slots,), np.int32) if carry
                      else np.asarray(tokens, np.int32))
         key_data = np.asarray(key_data, np.uint32)
         mask = self._active_np(active)
+        payload = (
+            tokens_np, mask, key_data,
+            np.asarray(base_steps, np.int32),
+            np.asarray(temps, np.float32),
+            np.asarray(top_ps, np.float32),
+            np.asarray(sampled_mask, bool),
+            np.asarray(steps_left, np.int32),
+            np.asarray(stop_tokens, np.int32),
+        )
 
-        def op():
-            # a = n_steps, b = key-data width, c = carry flag.
-            self._send_header(OP_WSAMPLEP, n_steps, key_data.shape[1],
-                              carry)
-            payload = self._bcast((
-                tokens_np, mask, key_data,
-                np.asarray(base_steps, np.int32),
-                np.asarray(temps, np.float32),
-                np.asarray(top_ps, np.float32),
-                np.asarray(sampled_mask, bool),
-                np.asarray(steps_left, np.int32),
-            ))
-            return self._exec_window_sampled_pipelined(
-                params, *(np.asarray(x) for x in payload),
-                n_steps=n_steps, carry=bool(carry),
-            )
-
-        return self._traced_run(("wsamplep", n_steps), op)
+        # a = n_steps, b = key-data width, c = carry flag.
+        self._queue_op(
+            (OP_WSAMPLEP, n_steps, key_data.shape[1], carry), payload,
+            lambda: self._exec_window_sampled_pipelined(
+                params, *payload, n_steps=n_steps, carry=bool(carry),
+            ),
+        )
+        return self._flush_ops(("wsamplep", n_steps))
 
     def _exec_window_sampled_pipelined(self, params, tokens, mask,
                                        key_data, base_steps, temps,
-                                       top_ps, smask, caps, *,
+                                       top_ps, smask, caps, stops, *,
                                        n_steps: int, carry: bool):
         toks_in = (self._carry_tokens() if carry
                    else self._global(tokens.astype(np.int32)))
@@ -574,6 +753,7 @@ class SlicePagedKVCache(PagedKVCache):
             self._global(top_ps.astype(np.float32)),
             self._global(smask.astype(bool)),
             self._global(caps.astype(np.int32)),
+            self._global(stops.astype(np.int32)),
         )
         self._carry = (toks, n_steps)
         return toks
@@ -589,6 +769,7 @@ class SlicePagedKVCache(PagedKVCache):
         programs were compiled at dispatch, and the steady budget is
         sized for device execution, not compilation."""
         self._check_live()
+        self._flush_ops()
         return self._traced_run(("wharvest",), lambda: self._read(handle))
 
     # ---- preemptive swap (scheduler, SERVING.md rung 17) -----------------
@@ -600,6 +781,7 @@ class SlicePagedKVCache(PagedKVCache):
         follower replays the op in the totally-ordered stream and
         discards its (identical) copy."""
         self._check_live()
+        self._flush_ops()
         ids_np = np.asarray(ids, np.int32)
 
         def op():
@@ -624,13 +806,13 @@ class SlicePagedKVCache(PagedKVCache):
         ids_np = np.asarray(ids, np.int32)
         arrs = tuple(np.asarray(a) for a in arrays)
 
-        def op():
-            self._send_header(OP_SWAPIN, ids_np.shape[0])
-            payload = [np.asarray(x)
-                       for x in self._bcast((ids_np,) + arrs)]
-            self._exec_swapin(payload[0], tuple(payload[1:]))
-
-        self._traced_run(("swapin", ids_np.shape[0]), op)
+        # Deferred (rung 23): the snapshot bytes ride the next flush's
+        # frame — a swap-in immediately followed by the window dispatch
+        # that needed those pages pays one broadcast, not two.
+        self._queue_op(
+            (OP_SWAPIN, ids_np.shape[0], 0, 0), (ids_np,) + arrs,
+            lambda: self._exec_swapin(ids_np, arrs),
+        )
 
     def _exec_swapin(self, ids: np.ndarray, arrays: tuple) -> None:
         self.state = self._k_swapin(
@@ -654,6 +836,7 @@ class SlicePagedKVCache(PagedKVCache):
 
     def _device_spec(self, params, tokens, active, spec_mask):
         self._check_live()
+        self._flush_ops()
         tokens = np.asarray(tokens, np.int32)
         mask = self._active_np(active)
 
@@ -678,7 +861,8 @@ class SlicePagedKVCache(PagedKVCache):
                 self._read(logits0))
 
     def _device_spec_window(self, params, tokens, n_passes: int,
-                            k_len: int, active, budgets, ctx, ctx_len):
+                            k_len: int, active, budgets, ctx, ctx_len,
+                            sampling=None):
         """Leader: broadcast + enqueue one device-resident spec window
         WITHOUT reading the result (the windowed twin of OP_WINDOWP).
         ``tokens=None`` selects the device-resident spec carry —
@@ -686,7 +870,14 @@ class SlicePagedKVCache(PagedKVCache):
         previous window, which every process holds replicated from its
         own execution, so nothing blocks between back-to-back windows.
         Header ``c`` carries the drafting-context width (0 = carry, so
-        followers know which payload template to expect)."""
+        followers know which payload template to expect).
+
+        ``sampling`` (rung 23) switches the op to OP_SPECWS — the
+        mixed greedy/sampled program — whose header ``b`` packs
+        ``k_len * 256 + key-data width`` (both are tiny; the follower
+        unpacks with divmod) and whose payload appends the five
+        sampler arrays. The two programs share one carry triple, so a
+        pipeline hands the carry between them freely."""
         self._check_live()
         carry = tokens is None
         if carry:
@@ -701,22 +892,36 @@ class SlicePagedKVCache(PagedKVCache):
             width = int(ctx_np.shape[1])
         mask = self._active_np(active)
         budgets_np = np.asarray(budgets, np.int32)
-
-        def op():
-            self._send_header(OP_SPECW, n_passes, k_len, width)
-            payload = self._bcast(
-                (tokens_np, mask, budgets_np, ctx_np, ctx_len_np)
+        payload = (tokens_np, mask, budgets_np, ctx_np, ctx_len_np)
+        if sampling is None:
+            hdr = (OP_SPECW, n_passes, k_len, width)
+        else:
+            key_data, base_steps, temps, top_ps, smask = sampling
+            key_data = np.asarray(key_data, np.uint32)
+            payload = payload + (
+                key_data,
+                np.asarray(base_steps, np.int32),
+                np.asarray(temps, np.float32),
+                np.asarray(top_ps, np.float32),
+                np.asarray(smask, bool),
             )
-            return self._exec_spec_window(
-                params, *(np.asarray(x) for x in payload),
+            hdr = (OP_SPECWS, n_passes,
+                   k_len * 256 + key_data.shape[1], width)
+
+        self._queue_op(
+            hdr, payload,
+            lambda: self._exec_spec_window(
+                params, *payload,
                 n_passes=n_passes, k_len=k_len, carry=carry,
-            )
-
-        return self._traced_run(("specw", n_passes, k_len), op)
+            ),
+        )
+        return self._flush_ops((_OP_NAMES[hdr[0]], n_passes, k_len))
 
     def _exec_spec_window(self, params, tokens: np.ndarray,
                           mask: np.ndarray, budgets: np.ndarray,
-                          ctx: np.ndarray, ctx_len: np.ndarray, *,
+                          ctx: np.ndarray, ctx_len: np.ndarray,
+                          key_data=None, base_steps=None, temps=None,
+                          top_ps=None, smask=None, *,
                           n_passes: int, k_len: int, carry: bool):
         if carry:
             pending, ctx_dev, ctx_len_dev = self._spec_carry
@@ -724,12 +929,23 @@ class SlicePagedKVCache(PagedKVCache):
             pending = self._global(tokens.astype(np.int32))
             ctx_dev = self._global(ctx.astype(np.int32))
             ctx_len_dev = self._global(ctx_len.astype(np.int32))
+        if key_data is None:
+            kernel, extra = self._k_specw, ()
+        else:
+            kernel = self._k_specws
+            extra = (
+                self._global(np.asarray(key_data).astype(np.uint32)),
+                self._global(np.asarray(base_steps).astype(np.int32)),
+                self._global(np.asarray(temps).astype(np.float32)),
+                self._global(np.asarray(top_ps).astype(np.float32)),
+                self._global(np.asarray(smask).astype(bool)),
+            )
         (emitted, counts, pend_out, ctx_out, ctx_len_out,
-         self.state) = self._k_specw(
+         self.state) = kernel(
             params, self.state, pending, self.cfg, n_passes, k_len,
             self._global(mask.astype(bool)),
             self._global(budgets.astype(np.int32)),
-            ctx_dev, ctx_len_dev,
+            ctx_dev, ctx_len_dev, *extra,
         )
         self._spec_carry = (pend_out, ctx_out, ctx_len_out)
         return emitted, counts, pend_out
@@ -739,6 +955,7 @@ class SlicePagedKVCache(PagedKVCache):
         ``harvest_window``: deadline-bounded but NOT a broadcast — the
         outputs are replicated and followers never read them."""
         self._check_live()
+        self._flush_ops()
         return self._traced_run(
             ("specwharvest",),
             lambda: (self._read(handle["emitted"]),
@@ -764,6 +981,10 @@ class SlicePagedKVCache(PagedKVCache):
         if self._stopped:
             return
         self._stopped = True
+        # Buffered coalescable ops die here unbroadcast: post-stop
+        # device state is irrelevant (the followers are released and
+        # teardown syncs are already local no-ops).
+        self._discard_pending_ops()
         if self._ops.dead is not None:
             return  # stream already wedged; nothing left to release
         try:
@@ -806,6 +1027,11 @@ class SlicePagedKVCache(PagedKVCache):
             name="kvedge-slice-ops",
         )
         old.shutdown()
+        # Ops buffered before the failure never reached the followers
+        # and never ran on the leader either — and the barrier SYNC
+        # below re-syncs tables from the authoritative host copies, so
+        # replaying them into the fresh stream would be a double-apply.
+        self._discard_pending_ops()
         # Any in-flight pipelined window died with the old stream; the
         # revived serving loop restarts from host tokens (its first
         # dispatch is never a carry), so the stale device carry must
@@ -848,12 +1074,38 @@ class SlicePagedKVCache(PagedKVCache):
         # in its own timeline.
         tr = getattr(self, "tracer", None)
         t0 = tr.now() if tr is not None else 0.0
-        if op == OP_SYNC:
-            tables, lengths = self._bcast((
-                np.zeros((self.slots, self.max_pages_per_seq), np.int32),
-                np.zeros((self.slots,), np.int32),
-            ))
-            self._apply_sync(np.asarray(tables), np.asarray(lengths))
+        if op in _COALESCABLE:
+            # One zero-template broadcast shaped by _multi_templates —
+            # the same shape table that carves OP_MULTI frames — then
+            # the shared replay path. Bare and frame-carried ops are
+            # identical past the receive.
+            payload = [
+                np.asarray(x) for x in self._bcast(tuple(
+                    np.zeros(shape, dtype)
+                    for shape, dtype in self._multi_templates(op, a, b, c)
+                ))
+            ]
+            self._replay_packed(params, op, a, b, c, payload)
+        elif op == OP_MULTI:
+            # a = op count, b = frame bytes: one uint8 broadcast, then
+            # carve [header | arrays]* by the embedded headers and
+            # replay each through the same exec path, in frame order.
+            frame = np.asarray(self._bcast(np.zeros((b,), np.uint8)))
+            off = 0
+            for _ in range(a):
+                sub = np.frombuffer(
+                    frame.data, np.int64, count=_HEADER_LEN, offset=off)
+                off += _HEADER_LEN * 8
+                sop, sa, sb, sc = (int(v) for v in sub)
+                payload = []
+                for shape, dtype in self._multi_templates(sop, sa, sb, sc):
+                    count = int(np.prod(shape, dtype=np.int64))
+                    arr = np.frombuffer(
+                        frame.data, dtype, count=count, offset=off,
+                    ).reshape(shape)
+                    off += arr.nbytes
+                    payload.append(arr)
+                self._replay_packed(params, sop, sa, sb, sc, payload)
         elif op == OP_PREFILL:
             tokens = self._bcast(np.zeros((c,), np.int32))
             self._exec_prefill(params, np.asarray(tokens), a, b)
@@ -894,62 +1146,12 @@ class SlicePagedKVCache(PagedKVCache):
             ))
             self._exec_spec(params, np.asarray(tokens),
                             np.asarray(mask), np.asarray(smask))
-        elif op == OP_WINDOWP:
-            # a = n_steps, b = carry flag. The dispatch-only replay:
-            # the follower enqueues the same program and moves on —
-            # it must not block on the previous window's result, or
-            # the leader's overlap would re-serialize at each host.
-            tokens, mask, caps = self._bcast((
-                np.zeros((self.slots,), np.int32),
-                np.zeros((self.slots,), bool),
-                np.zeros((self.slots,), np.int32),
-            ))
-            self._exec_window_pipelined(
-                params, np.asarray(tokens), np.asarray(mask),
-                np.asarray(caps), n_steps=a, carry=bool(b),
-            )
-        elif op == OP_WSAMPLEP:
-            # a = n_steps, b = key-data width, c = carry flag.
-            payload = self._bcast((
-                np.zeros((self.slots,), np.int32),
-                np.zeros((self.slots,), bool),
-                np.zeros((self.slots, b), np.uint32),
-                np.zeros((self.slots,), np.int32),
-                np.zeros((self.slots,), np.float32),
-                np.zeros((self.slots,), np.float32),
-                np.zeros((self.slots,), bool),
-                np.zeros((self.slots,), np.int32),
-            ))
-            self._exec_window_sampled_pipelined(
-                params, *(np.asarray(x) for x in payload), n_steps=a,
-                carry=bool(c),
-            )
-        elif op == OP_SPECW:
-            # a = n_passes, b = k_len, c = drafting-context width
-            # (0 = device-resident carry; a width-1 placeholder still
-            # rides the broadcast so the payload shape is fixed).
-            width = c if c > 0 else 1
-            payload = self._bcast((
-                np.zeros((self.slots,), np.int32),
-                np.zeros((self.slots,), bool),
-                np.zeros((self.slots,), np.int32),
-                np.zeros((self.slots, width), np.int32),
-                np.zeros((self.slots,), np.int32),
-            ))
-            self._exec_spec_window(
-                params, *(np.asarray(x) for x in payload),
-                n_passes=a, k_len=b, carry=c == 0,
-            )
         elif op == OP_SWAPOUT:
             # a = page count. The gather's replicated result is
             # discarded — only the leader's host copy becomes the
             # snapshot; the follower just joins the collective.
             ids = self._bcast(np.zeros((a,), np.int32))
             self._exec_swapout(np.asarray(ids))
-        elif op == OP_SWAPIN:
-            payload = [np.asarray(x)
-                       for x in self._bcast(self._swap_templates(a))]
-            self._exec_swapin(payload[0], tuple(payload[1:]))
         else:  # pragma: no cover - protocol corruption is slice-fatal
             raise PagedCacheError(f"unknown slice-serve op {op}")
         if tr is not None:

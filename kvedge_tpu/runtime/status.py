@@ -145,6 +145,16 @@ _SERVE_METRIC_FIELDS = (
     ("spec_windows_total", "serve_spec_windows_total", "counter",
      "device-resident speculative windows harvested (paged backend, "
      "serving_spec_window)"),
+    # Device-resident endgame (SERVING.md rung 23): whether mixed
+    # greedy+sampled batches stay on the windowed spec path, and how
+    # many finishes the device-side stop detection completed.
+    ("spec_window_sampled", "serve_spec_window_sampled", "gauge",
+     "1 if sampled co-tenants ride the windowed spec path on device "
+     "(serving_spec_sampled_window; 0 = mixed batches fall back to "
+     "the legacy per-pass program)"),
+    ("stop_finishes_total", "serve_stop_finishes_total", "counter",
+     "requests finished by per-row stop-token detection inside the "
+     "device scan (paged backend; stop_token set on the request)"),
     # Failure surface (runtime/failures.py): 1 once the pool has been
     # poisoned by a serving failure. With the recovery supervisor active
     # (runtime/recovery.py) this clears again after a successful heal —
@@ -360,6 +370,23 @@ def render_metrics(snapshot: dict) -> str:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name} {value}")
+    # Labelled counter (the one non-scalar serving metric): spec-window
+    # fallbacks by cause. "sampled" must pin to 0 in mixed steady state
+    # once serving_spec_sampled_window is on — that is rung 23's
+    # acceptance gate, so the cause label is load-bearing, not garnish.
+    fallbacks = serving.get("spec_window_fallbacks")
+    if isinstance(fallbacks, dict) and fallbacks:
+        name = "kvedge_serve_spec_window_fallbacks_total"
+        lines.append(
+            f"# HELP {name} decode rounds that fell off the windowed "
+            "spec path, by cause (sampled = mixed batch with "
+            "serving_spec_sampled_window off; spec_off = speculation "
+            "disabled mid-flight; overlap_off = serial loop with "
+            "spec windows configured)")
+        lines.append(f"# TYPE {name} counter")
+        for cause in sorted(fallbacks):
+            lines.append(
+                f'{name}{{cause="{cause}"}} {fallbacks[cause]}')
     for key, suffix, help_text in _SERVE_HISTOGRAM_FIELDS:
         hist = serving.get(key)
         if isinstance(hist, dict):

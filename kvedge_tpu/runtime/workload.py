@@ -1405,6 +1405,7 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 # not a silent fallback.
                 spec_window=(cfg.serving_spec_window
                              if spec_draft > 0 else 0),
+                spec_sampled_window=cfg.serving_spec_sampled_window,
                 window=cfg.serving_window,
                 kv_dtype=cfg.serving_kv_dtype,
                 cache=cache,

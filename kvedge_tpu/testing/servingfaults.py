@@ -188,20 +188,21 @@ class FaultyCache(PagedKVCache):
     # a harvest can die on a window that was dispatched healthy. Both
     # must drain cleanly into the poison path.
     def _device_window_dispatch(self, params, tokens, n_steps: int,
-                                active, steps_left):
+                                active, steps_left, stop_tokens):
         self._seam(f"windowp[{n_steps}]")
         return super()._device_window_dispatch(
-            params, tokens, n_steps, active, steps_left
+            params, tokens, n_steps, active, steps_left, stop_tokens
         )
 
     def _device_window_sampled_dispatch(self, params, tokens,
                                         n_steps: int, active, key_data,
                                         base_steps, temps, top_ps,
-                                        sampled_mask, steps_left):
+                                        sampled_mask, steps_left,
+                                        stop_tokens):
         self._seam(f"wsamplep[{n_steps}]")
         return super()._device_window_sampled_dispatch(
             params, tokens, n_steps, active, key_data, base_steps,
-            temps, top_ps, sampled_mask, steps_left,
+            temps, top_ps, sampled_mask, steps_left, stop_tokens,
         )
 
     def harvest_window(self, handle):
@@ -215,11 +216,12 @@ class FaultyCache(PagedKVCache):
     # poison path must settle (or cleanly abandon) the worst-case
     # _spec_unharvested reservation either way.
     def _device_spec_window(self, params, tokens, n_passes: int,
-                            k_len: int, active, budgets, ctx, ctx_len):
+                            k_len: int, active, budgets, ctx, ctx_len,
+                            sampling=None):
         self._seam(f"specw[{n_passes}]")
         return super()._device_spec_window(
             params, tokens, n_passes, k_len, active, budgets, ctx,
-            ctx_len,
+            ctx_len, sampling,
         )
 
     def _force_spec_window(self, handle):

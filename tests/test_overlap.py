@@ -184,11 +184,18 @@ def test_capped_window_freezes_finished_rows(params):
     capped = np.asarray(cache_c.harvest_window(handle))
     cache_c.drop_carry()
 
+    # The harvest block is [n_steps + 2, slots]: the produced tokens
+    # plus the packed [fin, stop_at] finish-bookkeeping rows (rung 23).
+    assert capped.shape[0] == n + 2
     # Live prefixes match the uncapped program exactly.
     assert capped[:3, 0].tolist() == full[:3, 0].tolist()
-    assert capped[:, 2].tolist() == full[:, 2].tolist()
+    assert capped[:n, 2].tolist() == full[:, 2].tolist()
     # Past its cap the frozen row re-emits its last live token.
-    assert all(int(t) == int(capped[2, 0]) for t in capped[3:, 0])
+    assert all(int(t) == int(capped[2, 0]) for t in capped[3:n, 0])
+    # Finish reasons: both active rows froze on their caps (1); the
+    # inactive row reports 0 and no stop was configured anywhere.
+    assert capped[n].tolist() == [1, 0, 1]
+    assert capped[n + 1].tolist() == [0, 0, 0]
     # Lengths advanced by the CAP, not the window.
     assert (cache_c._host_lengths[0]
             == cache_u._host_lengths[0] - (n - 3))
@@ -220,8 +227,10 @@ def test_pipeline_carry_matches_serial_window(params):
                                  active=active)
     # Second window rides the carry; the host has NOT seen h1 yet.
     h2 = cache_p.dispatch_window(params, None, 4, active=active)
-    got = np.concatenate([np.asarray(cache_p.harvest_window(h1)),
-                          np.asarray(cache_p.harvest_window(h2))])
+    # Token rows only — each harvest block carries two extra packed
+    # finish-bookkeeping rows past its n_steps tokens (rung 23).
+    got = np.concatenate([np.asarray(cache_p.harvest_window(h1))[:4],
+                          np.asarray(cache_p.harvest_window(h2))[:4]])
     cache_p.drop_carry()
     assert got[:, 0].tolist() == serial[:, 0].tolist()
     assert cache_p._host_lengths == cache_s._host_lengths

@@ -108,6 +108,23 @@ def test_serving_spec_window_round_trips_and_validates():
             RuntimeConfig.parse(f"[payload]\n{bad}\n")
 
 
+def test_serving_spec_sampled_window_round_trips_and_validates():
+    """Rung 23 knob: default ON (mixed batches stay windowed), TOML
+    round-trip, and the boolean validation matches the other flags."""
+    cfg = RuntimeConfig.parse(
+        "[payload]\nserving = 'paged'\nserving_speculative = 4\n"
+        "serving_spec_window = 8\n"
+        "serving_spec_sampled_window = false\n"
+    )
+    assert cfg.serving_spec_sampled_window is False
+    assert RuntimeConfig.parse(cfg.to_toml()) == cfg
+    assert RuntimeConfig.parse("").serving_spec_sampled_window is True
+    with pytest.raises(RuntimeConfigError):
+        RuntimeConfig.parse(
+            "[payload]\nserving_spec_sampled_window = 'yes'\n"
+        )
+
+
 def test_model_section_parses_and_round_trips():
     cfg = RuntimeConfig.parse(
         "[model]\npreset = \"flagship\"\nn_kv_heads = 2\nexperts = 4\n"

@@ -987,13 +987,14 @@ def test_multipage_window_matches_generate(params):
         return real_window(params_, tokens, n_steps, active=active)
 
     def spy_dispatch(params_, tokens, n_steps, active=None,
-                     steps_left=None):
+                     steps_left=None, stop_tokens=None):
         # The overlapped loop (default serving_overlap) dispatches
         # through here; the window plan is identical to the serial
         # path's, so the assertions below hold for both loop bodies.
         windows.append(n_steps)
         return real_dispatch(params_, tokens, n_steps, active=active,
-                             steps_left=steps_left)
+                             steps_left=steps_left,
+                             stop_tokens=stop_tokens)
 
     server._cache.step_window = spy_window
     server._cache.dispatch_window = spy_dispatch

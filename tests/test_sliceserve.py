@@ -324,8 +324,9 @@ def test_slice_stop_after_dead_stream_is_bounded(params, mesh):
         raise RuntimeError("wedged bcast released")
 
     cache._bcast = wedged
+    cache.admit(0, 4)  # admit QUEUES the table sync (deferred, rung 23)
     with pytest.raises(SliceFollowerLost):
-        cache.admit(0, 4)  # admit syncs tables -> first broadcast wedges
+        cache._flush_ops()  # the flush is the first broadcast — wedges
     assert cache._ops.dead is not None
     cache._bcast = orig
     start = _time.monotonic()
@@ -388,3 +389,92 @@ def test_slice_overlap_server_greedy_and_sampled_match_plain(params,
     finally:
         plain.close()
         sliced.close()
+
+def test_slice_multi_frame_follower_replay_matches_leader(params, mesh):
+    """Coalesced broadcasts (SERVING.md rung 23), end to end: a page
+    boundary queues the table sync, and the window dispatch a moment
+    later flushes sync + dispatch as ONE framed OP_MULTI broadcast.
+    The leader's recorded op stream — frames included — replayed
+    through the REAL follower loop on a second cache reproduces the
+    leader's device tokens bit-exactly, which pins both the frame
+    carving (_multi_templates offsets) and the shared exec path."""
+    from kvedge_tpu.runtime.sliceserve import OP_MULTI, follow_paged
+
+    leader = SlicePagedKVCache(CFG, slots=2, pages=16, page_size=4,
+                               mesh=mesh)
+    log = []
+    orig = leader._bcast
+
+    def recording(tree):
+        out = orig(tree)
+        log.append(out)
+        return out
+
+    leader._bcast = recording
+    prompt = [3, 1, 4, 1, 5, 9, 2]
+    leader.admit(0, len(prompt))
+    logits = leader.prefill(params, 0, jnp.asarray(prompt, jnp.int32))
+    pend = np.zeros((2,), np.int32)
+    pend[0] = int(np.argmax(np.asarray(logits)))
+    active = np.array([True, False])
+    h1 = leader.dispatch_window(params, jnp.asarray(pend), 4,
+                                active=active)
+    h2 = leader.dispatch_window(params, None, 4, active=active)
+    want = np.asarray(leader.harvest_window(h2))
+    leader.drop_carry()
+    leader.stop()  # OP_STOP ends the recorded stream
+    # Page growth put a sync in front of each dispatch: both flushes
+    # actually coalesced (2 ops per frame), and the frames are on the
+    # wire as OP_MULTI headers.
+    assert leader.coalesced_flushes >= 1
+    assert leader.coalesced_ops >= 2 * leader.coalesced_flushes
+    headers = [t for t in log
+               if isinstance(t, np.ndarray) and t.shape == (4,)
+               and t.dtype == np.int64]
+    assert any(int(h[0]) == OP_MULTI for h in headers)
+
+    follower = SlicePagedKVCache(CFG, slots=2, pages=16, page_size=4,
+                                 mesh=mesh)
+    replay = iter(log)
+    follower._bcast = lambda tree: next(replay)
+    follow_paged(follower, params)
+    toks, n_steps = follower._carry
+    assert n_steps == 4
+    np.testing.assert_array_equal(np.asarray(toks), want)
+
+
+@pytest.mark.window
+def test_slice_server_sampled_spec_window_matches_plain(params, mesh):
+    """OP_SPECWS over the slice cache: a mixed greedy + sampled batch
+    stays on the windowed spec path (no fallback to per-pass), and both
+    streams match the plain single-host server bit-exactly."""
+    key = jax.random.fold_in(jax.random.PRNGKey(11), 0)
+    prompt_g, prompt_s = [5, 9, 2, 5, 9, 2, 5, 9], [1, 2, 3, 4]
+
+    def build(cache=None, **kw):
+        return PagedGenerationServer(
+            params, CFG, cache=cache, speculative=3, spec_window=4,
+            overlap="on", **kw)
+
+    results = []
+    for backend in ("plain", "slice"):
+        if backend == "plain":
+            server = build(slots=2, pages=40)
+        else:
+            cache = SlicePagedKVCache(
+                CFG, slots=2, pages=40, page_size=4, mesh=mesh,
+                max_pages_per_seq=-(-(CFG.max_seq + 3) // 4),
+            )
+            server = build(cache=cache)
+        try:
+            sampling = (key, jnp.float32(0.8), jnp.float32(0.9))
+            greedy = server.submit(prompt_g, n_new=12)
+            sampled = server.submit(prompt_s, n_new=10,
+                                    sampling=sampling)
+            stats = server.stats()
+            results.append((greedy, sampled))
+        finally:
+            server.close()
+        assert stats["spec_windows_total"] >= 1
+    assert results[0] == results[1]
+    assert results[0][0] == reference(params, prompt_g, 12)

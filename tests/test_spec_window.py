@@ -7,9 +7,11 @@ amortizes over up to W*(1+K) tokens instead of taxing every pass. The
 pinned contract is that windows are a SCHEDULING change only: token
 streams are bit-identical to the legacy per-pass speculative path and
 to plain greedy decode, and the pipeline composes with everything the
-overlap loop already guarantees — sampled co-tenants (legacy
-fallback), scheduler preemption, poison-drain-revive recovery, and the
-slice broadcast protocol (OP_SPECW, tested in test_sliceserve.py).
+overlap loop already guarantees — sampled co-tenants (on-device
+accept/reject since rung 23; legacy fallback only when the
+spec_sampled_window knob is off), scheduler preemption,
+poison-drain-revive recovery, and the slice broadcast protocol
+(OP_SPECW/OP_SPECWS, tested in test_sliceserve.py).
 All fixed-seed and fast: these run in the tier-1 gate.
 """
 
@@ -127,41 +129,78 @@ def test_spec_window_serial_overlap_off_still_exact(params):
         assert got[i] == reference(params, prompt, n_new), i
 
 
-def test_sampled_cotenant_falls_back_to_legacy_pass(params):
-    """A sampled request in the batch disables windows for the batch
-    (drafts can never accept against a sampled row, and the legacy
-    pass advances it with the exact key schedule); both streams stay
-    bit-identical to their references."""
-    sampling = (jax.random.fold_in(jax.random.PRNGKey(7), 0),
-                jnp.float32(0.8), jnp.float32(0.9))
-    prompt_g, prompt_s = [5, 9, 2, 7], [1, 2, 3, 4]
+SAMPLING = (jax.random.fold_in(jax.random.PRNGKey(7), 0),
+            jnp.float32(0.8), jnp.float32(0.9))
+PROMPT_G, PROMPT_S = [5, 9, 2, 7], [1, 2, 3, 4]
 
+
+def _mixed_references(params):
     plain = PagedGenerationServer(params, CFG, slots=2, pages=32,
                                   page_size=4)
     try:
-        want_s = plain.submit(prompt_s, 12, sampling=sampling)
+        want_s = plain.submit(PROMPT_S, 12, sampling=SAMPLING)
     finally:
         plain.close()
+    return reference(params, PROMPT_G, 9), want_s
 
+
+def _run_mixed(server):
+    """Guaranteed co-residency: stream the sampled request first (one
+    yielded token proves it is admitted and mid-flight), THEN submit
+    the greedy one — the spec boundary sees a genuinely mixed batch,
+    which is the only state where the sampled-window path (or its
+    counted fallback) can trigger."""
+    stream = server.submit_stream(PROMPT_S, n_new=12, sampling=SAMPLING)
+    first = next(stream)
+    got_g = server.submit(PROMPT_G, 9)
+    got_s = PROMPT_S + [first] + list(stream)
+    return {"g": got_g, "s": got_s}
+
+
+def test_sampled_cotenant_stays_windowed_bit_identical(params):
+    """Rung 23: a sampled request in the batch no longer collapses the
+    window — its accept/reject runs IN the scan with per-row keys split
+    on device, advancing exactly one token per pass on the legacy key
+    schedule. Both streams stay bit-identical to their references, the
+    windows actually ran, and the "sampled" fallback counter stays 0
+    (the ISSUE acceptance bar for mixed steady state)."""
+    want_g, want_s = _mixed_references(params)
+    # window=2 keeps solo stretches short: admission boundaries come
+    # every couple of tokens, so the greedy arrival genuinely joins
+    # the sampled request mid-stream instead of racing its finish.
     server = PagedGenerationServer(params, CFG, slots=2, pages=32,
-                                   page_size=4, speculative=3,
-                                   spec_window=4)
+                                   page_size=4, window=2,
+                                   speculative=3, spec_window=4)
     try:
-        results = {}
-
-        def sub(key, prompt, n_new, **kw):
-            results[key] = server.submit(prompt, n_new, **kw)
-
-        ts = [threading.Thread(target=sub,
-                               args=("g", prompt_g, 9)),
-              threading.Thread(target=sub, args=("s", prompt_s, 12),
-                               kwargs={"sampling": sampling})]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join(timeout=300)
-        assert results["g"] == reference(params, prompt_g, 9)
+        results = _run_mixed(server)
+        stats = server.stats()
+        assert results["g"] == want_g
         assert results["s"] == want_s
+        assert stats["spec_window_sampled"] == 1
+        assert stats["spec_windows_total"] >= 1
+        assert stats["spec_window_fallbacks"]["sampled"] == 0
+    finally:
+        server.close()
+
+
+def test_sampled_window_knob_off_falls_back_counted(params):
+    """spec_sampled_window=False restores the rung-20 collapse: a
+    sampled co-tenant sends the whole batch through the legacy
+    per-pass path. Tokens are bit-identical either way — the knob is
+    purely a scheduling escape hatch — and every collapse is counted
+    under cause="sampled"."""
+    want_g, want_s = _mixed_references(params)
+    server = PagedGenerationServer(params, CFG, slots=2, pages=32,
+                                   page_size=4, window=2,
+                                   speculative=3, spec_window=4,
+                                   spec_sampled_window=False)
+    try:
+        results = _run_mixed(server)
+        stats = server.stats()
+        assert results["g"] == want_g
+        assert results["s"] == want_s
+        assert stats["spec_window_sampled"] == 0
+        assert stats["spec_window_fallbacks"]["sampled"] >= 1
     finally:
         server.close()
 

@@ -51,6 +51,13 @@ Usage::
                                          # bucketed compile cache, HBM
                                          # page budget, watermark shed/
                                          # resume); fast, tier-1
+    python tools/run_tests.py --endgame  # only the device-resident
+                                         # endgame composition tests
+                                         # (-m endgame: sampled spec
+                                         # windows, device stop
+                                         # finishes, composed with
+                                         # preempt/revive/buckets);
+                                         # fast, tier-1
     python tools/run_tests.py --lint     # lock-discipline gate: runs
                                          # tools/locklint.py over the
                                          # package (fast-fails on any
@@ -214,6 +221,11 @@ def main(argv: list[str] | None = None) -> int:
                          "resume-after-revive, page-audit trips, and — "
                          "without the tier-1 'not slow' filter — the "
                          "full seeded soak)")
+    ap.add_argument("--endgame", action="store_true",
+                    help="run only the device-resident endgame "
+                         "composition tests (forwards -m endgame: "
+                         "sampled spec windows, device stop finishes, "
+                         "composed with preempt/revive/bucketing)")
     ap.add_argument("--lint", action="store_true",
                     help="run the lock-discipline gate: tools/locklint.py "
                          "over kvedge_tpu/, then the analyzer's own tests "
@@ -243,6 +255,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "capacity"]
     if args.chaos:
         args.pytest_args += ["-m", "chaos"]
+    if args.endgame:
+        args.pytest_args += ["-m", "endgame"]
     if args.lint:
         # The analyzer gate runs FIRST and fast-fails: a tree with
         # unsuppressed findings should not spend minutes in pytest
