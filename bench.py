@@ -951,6 +951,178 @@ def measure_openloop(cfg, prompt_len: int, page_size: int,
     return {"rates": rates, "legs": legs}
 
 
+PREFIX_SYS_TOKENS = 64   # the common system prompt (4 full pages)
+PREFIX_TAIL_TOKENS = 16  # per-request unique user suffix
+PREFIX_TURN1 = 8         # turn-1 conversations (warmup + replay base)
+PREFIX_CAL = 4           # calibration burst after compile warmup
+PREFIX_REQUESTS = 24     # measured open-loop arrivals
+PREFIX_N_NEW = 16
+PREFIX_SLOTS = 8
+
+
+def measure_prefix_openloop(cfg, page_size: int) -> dict:
+    """Shared-prefix serving (SERVING.md rung 24): ONE open-loop
+    arrival schedule — every prompt opens with a common 64-token
+    system prompt, and every second arrival is a multi-turn replay
+    embedding a full turn-1 transcript — replayed on two identical
+    servers, ``prefix_cache`` off then on. Same offsets, same prompts,
+    greedy: the radix cache may only change WHERE prompt K/V comes
+    from, so the leg asserts every emitted stream is bit-identical
+    across the two runs and reports what the cache bought — prefill
+    tokens saved (registered system-prompt pages for fresh arrivals,
+    prompt AND generated pages for replays) and the TTFT p50/p99
+    shift at the same arrival rate."""
+    import threading
+
+    from kvedge_tpu.models.serving import PagedGenerationServer
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    n_new = PREFIX_N_NEW
+    rng = np.random.default_rng(17)
+    sys_prompt = [int(t) for t in
+                  rng.integers(0, cfg.vocab, PREFIX_SYS_TOKENS)]
+    tails = rng.integers(
+        0, cfg.vocab,
+        size=(PREFIX_TURN1 + PREFIX_CAL + PREFIX_REQUESTS,
+              PREFIX_TAIL_TOKENS),
+    )
+    t1_prompts = [sys_prompt + [int(t) for t in tails[i]]
+                  for i in range(PREFIX_TURN1)]
+    # Worst-case request: a replay's transcript prompt plus its budget.
+    longest = (PREFIX_SYS_TOKENS + PREFIX_TAIL_TOKENS + n_new
+               + PREFIX_TAIL_TOKENS + n_new)
+    mpps = -(-longest // page_size)
+    offsets: np.ndarray | None = None
+    rate = [0.0]
+
+    def burst(server, prompts, outs=None) -> float:
+        errors: list[Exception] = []
+
+        def client(ci: int) -> None:
+            try:
+                got = server.submit(prompts[ci], n_new, timeout=600.0)
+                if outs is not None:
+                    outs[ci] = got
+            except Exception as e:  # pragma: no cover - fail loudly
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(ci,),
+                                    daemon=True)
+                   for ci in range(len(prompts))]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return time.perf_counter() - start
+
+    def run(prefix_on: bool) -> dict:
+        nonlocal offsets
+        server = PagedGenerationServer(
+            params, cfg, slots=PREFIX_SLOTS,
+            pages=PREFIX_SLOTS * mpps, page_size=page_size,
+            prefix_cache=prefix_on, window=OPENLOOP_WINDOW,
+            min_bucket=min(OPENLOOP_MIN_BUCKET, PREFIX_SLOTS),
+        )
+        try:
+            # Turn 1 (closed loop, unmeasured): compiles every program
+            # the measured leg touches and produces the transcripts
+            # the replay arrivals embed.
+            warm: dict[int, list[int]] = {}
+            burst(server, t1_prompts, warm)
+            # Rate calibration on a post-compile burst; the offsets
+            # computed on the FIRST (cache-off) run are reused verbatim
+            # for the cache-on run — same schedule, same rate.
+            cal_prompts = [
+                sys_prompt + [int(t) for t in tails[PREFIX_TURN1 + i]]
+                for i in range(PREFIX_CAL)
+            ]
+            cal_s = burst(server, cal_prompts)
+            if offsets is None:
+                rate[0] = 1.5 * PREFIX_CAL / cal_s
+                offsets = np.cumsum(np.random.default_rng(13).exponential(
+                    1.0 / rate[0], size=PREFIX_REQUESTS))
+            prompts = []
+            for ci in range(PREFIX_REQUESTS):
+                tail = [int(t) for t in
+                        tails[PREFIX_TURN1 + PREFIX_CAL + ci]]
+                if ci % 2:
+                    # Multi-turn replay: the full turn-1 transcript
+                    # (prompt + generated) plus a fresh follow-up.
+                    prompts.append(warm[ci % PREFIX_TURN1] + tail)
+                else:
+                    prompts.append(sys_prompt + tail)
+            base = server.stats()
+            emitted: dict[int, list[int]] = {}
+            errors: list[Exception] = []
+
+            def client(ci: int) -> None:
+                try:
+                    emitted[ci] = server.submit(prompts[ci], n_new,
+                                                timeout=600.0)
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(ci,),
+                                        daemon=True)
+                       for ci in range(PREFIX_REQUESTS)]
+            start = time.perf_counter()
+            for ci, t in enumerate(threads):
+                lag = start + offsets[ci] - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - start
+            if errors:
+                raise errors[0]
+            st = server.stats()
+            return {
+                "warm": warm,
+                "emitted": emitted,
+                "goodput_tokens_per_sec":
+                    PREFIX_REQUESTS * n_new / elapsed,
+                "ttft_p50_ms": _hist_delta_quantile(
+                    base["ttft_ms"], st["ttft_ms"], 0.50),
+                "ttft_p99_ms": _hist_delta_quantile(
+                    base["ttft_ms"], st["ttft_ms"], 0.99),
+                "prompt_tokens": sum(len(p) for p in prompts),
+                "prefill_tokens_saved":
+                    st["prefix_tokens_saved"]
+                    - base["prefix_tokens_saved"],
+                "prefix_hits": st["prefix_hits"] - base["prefix_hits"],
+                "cow_copies": st["prefix_cow_copies"],
+                "bytes_saved": st["prefix_bytes_saved"],
+            }
+        finally:
+            server.close()
+
+    off = run(False)
+    on = run(True)
+    # The whole point: reuse changes cost, never content.
+    for ci in range(PREFIX_TURN1):
+        if off["warm"][ci] != on["warm"][ci]:
+            raise RuntimeError(
+                f"prefix cache changed turn-1 stream {ci}")
+    for ci in range(PREFIX_REQUESTS):
+        if off["emitted"][ci] != on["emitted"][ci]:
+            raise RuntimeError(
+                f"prefix cache changed emitted stream {ci}")
+    for leg in (off, on):
+        del leg["warm"], leg["emitted"]
+    return {
+        "requests": PREFIX_REQUESTS,
+        "rate_req_per_sec": rate[0],
+        "saved_frac": on["prefill_tokens_saved"] / on["prompt_tokens"],
+        "bit_identical": True,
+        "off": off,
+        "on": on,
+    }
+
+
 def measure_trace_overhead(cfg, slots: int, prompt_len: int, n_new: int,
                            page_size: int) -> tuple[float, float]:
     """The rung-18 tracing bill on the paged decode leg, through the
@@ -1373,6 +1545,7 @@ def main() -> int:
         PAGED_PAGE_SIZE,
     )
     openloop = measure_openloop(gqa, DECODE_PROMPT, PAGED_PAGE_SIZE)
+    prefix_ol = measure_prefix_openloop(gqa, PAGED_PAGE_SIZE)
     trace_off_tps, trace_on_tps = measure_trace_overhead(
         gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE
     )
@@ -1565,6 +1738,39 @@ def main() -> int:
                     for (cap, mode, rate), leg in
                     openloop["legs"].items()
                 },
+                # Shared-prefix serving (SERVING.md rung 24): one
+                # open-loop schedule (common 64-token system prompt,
+                # every second arrival a multi-turn replay) run
+                # cache-off then cache-on at the SAME rate — emitted
+                # streams verified bit-identical, the cache's win
+                # reported as prefill tokens saved and the TTFT shift.
+                "prefix_openloop_requests": prefix_ol["requests"],
+                "prefix_openloop_rate_req_per_sec": round(
+                    prefix_ol["rate_req_per_sec"], 2
+                ),
+                "prefix_openloop_bit_identical":
+                    prefix_ol["bit_identical"],
+                "prefix_openloop_prefill_tokens_saved":
+                    prefix_ol["on"]["prefill_tokens_saved"],
+                "prefix_openloop_prefill_saved_frac": round(
+                    prefix_ol["saved_frac"], 3
+                ),
+                "prefix_openloop_cow_copies":
+                    prefix_ol["on"]["cow_copies"],
+                "prefix_openloop_goodput_tokens_per_sec": round(
+                    prefix_ol["on"]["goodput_tokens_per_sec"], 1
+                ),
+                "prefix_openloop_off_goodput_tokens_per_sec": round(
+                    prefix_ol["off"]["goodput_tokens_per_sec"], 1
+                ),
+                "prefix_openloop_ttft_p50_ms":
+                    prefix_ol["on"]["ttft_p50_ms"],
+                "prefix_openloop_off_ttft_p50_ms":
+                    prefix_ol["off"]["ttft_p50_ms"],
+                "prefix_openloop_ttft_p99_ms":
+                    prefix_ol["on"]["ttft_p99_ms"],
+                "prefix_openloop_off_ttft_p99_ms":
+                    prefix_ol["off"]["ttft_p99_ms"],
                 # Tracing bill (SERVING.md rung 18): the same loaded
                 # paged decode with serving_trace off vs on (sample
                 # 1.0, every request). A span is one deque append, so

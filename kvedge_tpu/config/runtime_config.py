@@ -333,6 +333,14 @@ class RuntimeConfig:
     # (exact — K/V depend only on prompt tokens/positions). Pins are
     # evicted LRU under pool pressure.
     serving_prefix_cache: bool = True
+    # Host-RAM byte budget for the prefix cache's residency tier
+    # ([payload] serving_prefix_host_mb, 0 = off): evicted prefix
+    # entries demote their verbatim page bytes (int8 scale slabs ride
+    # along) to host RAM instead of dropping, and a later prompt
+    # matching a host-resident prefix swaps it back into HBM at
+    # admission. LRU within the budget; requires
+    # serving_prefix_cache=true to have any effect.
+    serving_prefix_host_mb: int = 0
     # Prefix-cache persistence: on shutdown the registry's pinned K/V
     # pages dump to ``<state_dir>/prefix-cache.npz`` and a rescheduled
     # serve pod re-pins them at boot — warm prefixes ride the state
@@ -607,6 +615,10 @@ class RuntimeConfig:
                 serving_prefix_cache=payload_doc.get(
                     "serving_prefix_cache", cls.serving_prefix_cache
                 ),
+                serving_prefix_host_mb=int(
+                    payload_doc.get("serving_prefix_host_mb",
+                                    cls.serving_prefix_host_mb)
+                ),
                 serving_prefix_persist=payload_doc.get(
                     "serving_prefix_persist", cls.serving_prefix_persist
                 ),
@@ -810,6 +822,11 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving_prefix_persist must be a boolean"
             )
+        if self.serving_prefix_host_mb < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_prefix_host_mb must be >= 0 "
+                "(0 disables the host residency tier)"
+            )
         if not 1 <= self.serving_window <= 1024:
             raise RuntimeConfigError(
                 "[payload] serving_window must be in [1, 1024] "
@@ -985,6 +1002,7 @@ class RuntimeConfig:
             f"serving_prefill_chunk = {self.serving_prefill_chunk}\n"
             "serving_prefix_cache = "
             f"{'true' if self.serving_prefix_cache else 'false'}\n"
+            f"serving_prefix_host_mb = {self.serving_prefix_host_mb}\n"
             "serving_prefix_persist = "
             f"{'true' if self.serving_prefix_persist else 'false'}\n"
             f"serving_window = {self.serving_window}\n"

@@ -373,6 +373,21 @@ class PagedKVCache:
         prefix pins must not alias the live allocation list)."""
         return list(self._pages_of[slot])
 
+    def slot_length(self, slot: int) -> int:
+        """The slot's committed host-mirror length: positions
+        ``[0, slot_length)`` hold valid K/V for tokens 0..length-1 of
+        prompt + generated (spec drafts scribble only at or past the
+        committed length and are overwritten on acceptance), which is
+        what makes finish-time prefix registration exact."""
+        if slot not in self._pages_of:
+            raise PagedCacheError(f"slot {slot} is not admitted")
+        return self._host_lengths[slot]
+
+    def page_refcount(self, page: int) -> int:
+        """Current reference count of ``page`` (host bookkeeping only —
+        the chaos soak's refcount-aware conservation check reads it)."""
+        return self._refs[page]
+
     def retain_pages(self, pages: list[int]) -> None:
         """Take an extra reference on ``pages`` (the serving layer's
         prefix registry pins cached-prefix pages with this so releasing
@@ -638,6 +653,56 @@ class PagedKVCache:
                 "(kv_dtype mismatch between swap-out and swap-in?)"
             )
         self._device_swapin(ids, arrays)
+
+    def cow_page(self, slot: int, index: int) -> int | None:
+        """Copy-on-write divergence for table position ``index`` of
+        ``slot``: when the page there is SHARED (refcount > 1 — a
+        cached-prefix page other holders still read), copy its K/V into
+        a fresh page on device and repoint only this slot's table at
+        the copy, so the slot's upcoming writes (the partial last page
+        of a shared prefix fills in during prefill/decode) cannot
+        corrupt co-holders. Returns the new page id, or None when the
+        slot already owns the page exclusively (no copy, no cost).
+
+        The copy is a single device-side page copy (``_device_cow`` —
+        the slice cache overrides it to broadcast an OP_COWP so
+        followers replay the same copy in the totally-ordered op
+        stream); no bytes cross the host. The source keeps the
+        remaining holders' references; the copy starts at refcount 1
+        owned by the slot. Allocation may invoke pressure relief —
+        safe at the admission call site because the matched registry
+        entry's pages are also held by this slot's table, so evicting
+        the entry cannot free the source mid-copy."""
+        if slot not in self._pages_of:
+            raise PagedCacheError(f"slot {slot} is not admitted")
+        pages = self._pages_of[slot]
+        if not 0 <= index < len(pages):
+            raise PagedCacheError(
+                f"slot {slot} holds {len(pages)} pages — no index {index}"
+            )
+        src = pages[index]
+        if self._refs[src] <= 1:
+            return None
+        if not self._free and not (
+            self.pressure_relief and self.pressure_relief(1)
+        ):
+            raise PagedCacheError("pool exhausted: no page for COW copy")
+        dst = self._free.pop()
+        self._refs[dst] += 1
+        self._device_cow(src, dst)
+        pages[index] = dst
+        self._host_tables[slot][index] = dst
+        self._unref(src)
+        self._sync()
+        return dst
+
+    def _device_cow(self, src: int, dst: int) -> None:
+        """Device seam: copy page ``src``'s slabs into ``dst`` (K, V,
+        and int8 scale slabs when quantized). Slice cache broadcasts."""
+        self.state = _cow_page_impl(
+            self.state,
+            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32),
+        )
 
     def allocate_pinned_page(self) -> int:
         """Take one page off the free list with refcount 1, owned by the
@@ -1254,6 +1319,25 @@ def _scatter_pages_impl(state: PagedState, idx, arrays) -> PagedState:
         fields.update(
             scale_k=state.scale_k.at[:, idx].set(arrays[2]),
             scale_v=state.scale_v.at[:, idx].set(arrays[3]),
+        )
+    return dataclasses.replace(state, **fields)
+
+
+def _cow_page_impl(state: PagedState, src, dst) -> PagedState:
+    """Copy page ``src`` into page ``dst`` across every pool slab — the
+    COW divergence primitive. Bytes move device-to-device as stored
+    (no dequantization; int8 scale slabs ride along), so a diverged
+    copy is bit-identical to its source. ``src``/``dst`` arrive as
+    traced int32 scalars: the slice cache jits this impl once and
+    every (src, dst) pair replays the same compiled program."""
+    fields = dict(
+        pool_k=state.pool_k.at[:, dst].set(state.pool_k[:, src]),
+        pool_v=state.pool_v.at[:, dst].set(state.pool_v[:, src]),
+    )
+    if state.scale_k is not None:
+        fields.update(
+            scale_k=state.scale_k.at[:, dst].set(state.scale_k[:, src]),
+            scale_v=state.scale_v.at[:, dst].set(state.scale_v[:, src]),
         )
     return dataclasses.replace(state, **fields)
 

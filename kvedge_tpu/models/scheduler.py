@@ -83,6 +83,12 @@ _WAIT_EDGES_MS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0,
 # watermark and the retry_after hint): ~5 admissions of memory.
 _EWMA_ALPHA = 0.2
 
+# Prefix-affinity bypass bound (SERVING.md rung 24): at most this many
+# consecutive hot (HBM-resident-prefix) admissions may jump past a
+# head that does not fit before the head MUST admit next — bounded
+# priority inversion, never starvation.
+_BYPASS_CAP = 4
+
 
 class _Hist:
     """Fixed-bucket histogram in Prometheus shape: ``edges`` are ``le``
@@ -125,7 +131,7 @@ class _Entry:
 
     __slots__ = ("no", "pclass", "req", "pages_needed", "cond",
                  "enqueued_at", "resume", "saved_len", "arrays",
-                 "nbytes")
+                 "nbytes", "hot")
 
     def __init__(self, no: int, pclass: str, req, pages_needed: int,
                  cond, enqueued_at: float, *, resume: bool = False,
@@ -141,6 +147,11 @@ class _Entry:
         self.saved_len = saved_len
         self.arrays = arrays
         self.nbytes = nbytes
+        # Prefix affinity (SERVING.md rung 24): the serving layer
+        # refreshes this on each park-loop wake — True iff the
+        # ticket's prompt currently matches an HBM-resident cached
+        # prefix, making it cheaper to admit than its page count says.
+        self.hot = False
 
 
 class AdmissionScheduler:
@@ -224,6 +235,12 @@ class AdmissionScheduler:
         self.preemptions = 0
         self.resumes = 0
         self.shed = 0
+        # Prefix-affinity bypass (rung 24): consecutive hot admissions
+        # taken past a non-fitting head. Bounded (_BYPASS_CAP) so a
+        # stream of cache-hitting arrivals cannot starve a cold head —
+        # the streak resets every time the true head admits.
+        self.hot_bypasses = 0
+        self._bypass_streak = 0
 
     # ---- ranks & small queries ------------------------------------------
 
@@ -299,6 +316,30 @@ class AdmissionScheduler:
                    key=lambda c: ((self._served[c] + 1)
                                   / self._weights[c], self._rank[c]))
         return self._queues[best][0]
+
+    def bypass_ok_locked(self, entry: _Entry) -> bool:
+        """Prefix-affinity exception to head-of-line (rung 24): may
+        ``entry`` admit even though it is not the policy head?
+
+        Yes iff it is the FIRST hot parked ticket of the HEAD's class
+        (same class — cross-class bypass would reintroduce the priority
+        inversion this module removed) and the bypass streak is under
+        ``_BYPASS_CAP``. The serving layer additionally requires that
+        the head itself does NOT fit — bypass fills capacity the head
+        cannot use, it never delays a head that could run."""
+        if entry.resume or not entry.hot:
+            return False
+        if self._bypass_streak >= _BYPASS_CAP:
+            return False
+        head = self.head_locked()
+        if head is None or head is entry or head.pclass != entry.pclass:
+            return False
+        for e in self._queues[entry.pclass]:
+            if e.resume or e is head:
+                continue
+            if e.hot:
+                return e is entry
+        return False
 
     # ---- overload shedding -----------------------------------------------
 
@@ -403,7 +444,14 @@ class AdmissionScheduler:
     def admit_locked(self, entry: _Entry) -> None:
         """The head ticket won capacity: dequeue, record its measured
         queue wait (histogram + EWMA — the shed/hint input), charge the
-        weighted policy, and wake whoever is head now."""
+        weighted policy, and wake whoever is head now. A non-head
+        admission is a prefix-affinity bypass (``bypass_ok_locked``):
+        counted, and the streak advances so the cap can bite."""
+        if self.head_locked() is entry:
+            self._bypass_streak = 0
+        else:
+            self._bypass_streak += 1
+            self.hot_bypasses += 1
         self._remove(entry)
         self._served[entry.pclass] += 1
         now = time.monotonic()
@@ -447,8 +495,21 @@ class AdmissionScheduler:
         entries have no thread; the decode loop is woken by the serving
         layer's own ``notify_all`` on the work condition."""
         h = self.head_locked()
-        if h is not None and not h.resume:
+        if h is None:
+            return
+        if not h.resume:
             h.cond.notify_all()
+        # Also stir the head class's first hot ticket (rung 24): its
+        # park predicate may pass via bypass_ok_locked even while the
+        # head's cannot. Bounded: one extra notify, same class only.
+        if self._bypass_streak >= _BYPASS_CAP:
+            return
+        for e in self._queues[h.pclass]:
+            if e.resume or e is h:
+                continue
+            if e.hot:
+                e.cond.notify_all()
+                return
 
     def wake_all_locked(self) -> None:
         """Every parked waiter re-evaluates (close/drain/poison/cancel:
@@ -566,6 +627,7 @@ class AdmissionScheduler:
             "sched_preemptions_total": self.preemptions,
             "sched_resumes_total": self.resumes,
             "sched_shed_total": self.shed,
+            "sched_hot_bypass_total": self.hot_bypasses,
         }
         for c in self.classes:
             out[f"sched_queue_depth_{c}"] = self.depth_locked(c)

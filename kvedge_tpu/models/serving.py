@@ -121,8 +121,18 @@ class _Request:
     # Pages reserved at admission — stored on the request so release is
     # symmetric even if the server's spec mode changes mid-flight (the
     # auto guard rail can zero _spec; recomputing at release would then
-    # under-release a greedy request's slack).
+    # under-release a greedy request's slack). With a prefix-cache hit
+    # this is the PRIVATE part only (pages_needed − full shared pages);
+    # the shared pages are covered by leases (serving._lease).
     pages_reserved: int = 0
+    # Prefix sharing (rung 24): the FULL shared pages this request's
+    # table starts on (leased, registry-refcounted, read-only) and the
+    # trie node at that depth — the journal shadow's key. A partially
+    # shared page is COWed at admission and is private, never listed
+    # here. Both reset when a preempt/requeue round-trip materializes
+    # the request as self-contained bytes.
+    shared_pages: tuple = ()
+    prefix_node: "int | None" = None
     # Raw uint32 data of the sampling seed key, fetched ONCE at
     # admission (the sampled-window dispatch needs it host-side every
     # window; re-fetching from the device key per window would add a
@@ -256,6 +266,7 @@ class PagedGenerationServer:
                  tracer=None, debug_locks: bool = False,
                  checkpoint_every: int = 0,
                  journal_budget_mb: int = 0,
+                 prefix_host_mb: int = 0,
                  debug_pages: bool = False):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
@@ -459,31 +470,66 @@ class PagedGenerationServer:
         # sufficient because every other allocation is within its own
         # reservation.
         self._prefix_enabled = prefix_cache
-        # Trie over page-sized token blocks (NOT a dict of full-prefix
-        # tuples: that costs O(len^2/page) hashing under the lock per
-        # admission/registration). Node 0 is the root; an edge is
-        # (parent_id, block_tuple) -> child_id; a node may carry an
-        # entry {"pages": pinned page list, "last_used": LRU stamp}.
+        # Radix trie over page-sized token blocks (NOT a dict of
+        # full-prefix tuples: that costs O(len^2/page) hashing under
+        # the lock per admission/registration). Node 0 is the root;
+        # each node owns its out-edges {block_tuple: child_id} plus an
+        # optional HBM entry {"pages": pinned page list, "last_used":
+        # LRU stamp} and an optional host-tier record (rung 24b).
         # Lookup and registration walk the prompt once — O(len(prompt))
-        # total hashing — and eviction prunes childless, entry-less
-        # nodes upward so the trie never outlives its entries.
-        self._prefix_children: dict[tuple, int] = {}
+        # total hashing — and eviction prunes edge-less, entry-less,
+        # host-less nodes upward so the trie never outlives its
+        # residents. Node ids are monotonic and NEVER reused: the
+        # journal's shadow store keys on them across evictions.
         self._prefix_nodes: dict[int, dict] = {
-            0: {"parent": None, "children": 0, "entry": None},
+            0: {"parent": None, "edges": {}, "entry": None,
+                "host": None},
         }
         self._prefix_entry_nodes: dict[int, dict] = {}  # id -> entry
         self._prefix_next_id = 1
         self._prefix_hits = 0
+        self._prefix_lookups = 0
         self._prefix_tokens_saved = 0
+        self._prefix_cow_copies = 0
         self._prefix_registrations = 0  # persistence dirty counter
+        # Tiered residency (rung 24b): cold entries demote to host RAM
+        # as the verbatim swapout bytes instead of being dropped, up to
+        # ``prefix_host_mb`` (0 = off — evictions drop, exactly the
+        # pre-rung behavior). A hit on a host-resident entry promotes
+        # it back into fresh pinned pages at admission.
+        if prefix_host_mb < 0:
+            raise ValueError("prefix_host_mb must be >= 0")
+        self._prefix_host_budget = int(prefix_host_mb) << 20
+        self._prefix_host_nodes: dict[int, dict] = {}  # id -> record
+        self._prefix_host_bytes = 0
+        self._prefix_demotions = 0
+        self._prefix_promotions = 0
+        self._prefix_evictions = {
+            "admission": 0, "pressure": 0, "revive": 0,
+            "host_lru": 0, "host_over": 0,
+        }
+        # Live-sharer leases (rung 24 pricing): _reserved counts each
+        # request's PRIVATE worst case plus ONE unit per distinct
+        # shared prefix page any live request's table starts on —
+        # shared pages are billed once, which is what lets page-gated
+        # admission price an arrival at pages_needed − shared. The
+        # unit belongs to the LEASE, not a request: it frees when the
+        # last sharer releases, so an inheritor never loses coverage
+        # because the creator finished first.
+        self._lease: dict[int, int] = {}
+        # Journal shadow store (rung 24c): trie node id -> the shared
+        # prefix pages' verbatim swapout bytes, refcounted by the
+        # journal entries that REFERENCE them instead of duplicating
+        # them. Priced once against the journal budget (adjust_extra).
+        self._prefix_shadow: dict[int, dict] = {}
         self._persist_stop: threading.Event | None = None
         self._persist_thread: threading.Thread | None = None
         self._spec_decision: dict | None = None
         # Registry pins live OUTSIDE any request's reservation, so the
         # cache needs a way to reclaim them when a mid-decode grow finds
         # the free list empty — otherwise one tenant's growth would
-        # poison the whole server (see _relieve_pool_pressure).
-        self._cache.pressure_relief = self._relieve_pool_pressure
+        # poison the whole server (see _relieve_pool_pressure_locked).
+        self._cache.pressure_relief = self._relieve_pool_pressure_locked
         if tracer is not None:
             # Share the recorder with the cache: a slice-aware cache
             # (runtime/sliceserve.py) stamps per-op broadcast spans so
@@ -601,6 +647,11 @@ class PagedGenerationServer:
             )
             instrument_locked_methods(self, self._lock)
             instrument_locked_methods(self._sched, self._lock)
+        # Installed AFTER lock instrumentation so the journal's drop
+        # observer is the (possibly ownership-checked) bound method.
+        # Every journal call site holds the work lock, so the observer
+        # runs under it too.
+        self._journal.on_drop = self._journal_drop_locked
         self._thread = threading.Thread(
             target=self._loop, name="kvedge-paged-serve", daemon=True
         )
@@ -815,8 +866,18 @@ class PagedGenerationServer:
                 # low watermark, non-top-priority arrivals shed with
                 # page terms instead of parking behind a pool that
                 # cannot absorb them. The top class always parks — it
-                # is what the preemption path frees pages FOR.
-                shed = self._page_shed_locked(priority, pages_needed)
+                # is what the preemption path frees pages FOR. The
+                # price is the arrival's MARGINAL cost (rung 24): its
+                # private budget plus the lease units its shared
+                # prefix pages would newly pin — a mostly-cached
+                # prompt no longer sheds at full pages_needed.
+                self._prefix_lookups += 1
+                _, shared0, st0, _ = self._prefix_lookup(req.prompt)
+                shed = self._page_shed_locked(
+                    priority,
+                    self._admission_price_locked(
+                        pages_needed, shared0, st0),
+                )
             if shed is not None:
                 hint = shed["retry_after_s"]
                 if hint is None:
@@ -856,9 +917,31 @@ class PagedGenerationServer:
                             "request cancelled while queued for "
                             "admission"
                         )
-                    if (self._sched.head_locked() is ticket
-                            and self._free_slots
-                            and self._reserved + pages_needed
+                    # Re-priced each wake: the trie changes while this
+                    # ticket parks, so the marginal cost (private
+                    # budget + unleased shared pages) and the HBM-hot
+                    # flag both refresh here. A hot non-head ticket may
+                    # be admitted past a head STARVED for capacity
+                    # (prefix affinity, rung 24d) — bounded by the
+                    # scheduler's bypass cap so the head cannot starve
+                    # behind an endless hot stream.
+                    _, shared_w, st_w, _ = self._prefix_lookup(
+                        req.prompt)
+                    price = self._admission_price_locked(
+                        pages_needed, shared_w, st_w)
+                    ticket.hot = st_w > 0
+                    head = self._sched.head_locked()
+                    at_head = head is ticket
+                    if not at_head and ticket.hot:
+                        at_head = (
+                            head is not None
+                            and (not self._free_slots
+                                 or self._reserved + head.pages_needed
+                                 > self._pages_total)
+                            and self._sched.bypass_ok_locked(ticket)
+                        )
+                    if (at_head and self._free_slots
+                            and self._reserved + price
                             <= self._pages_total
                             and self._ensure_bucket_locked()):
                         break
@@ -886,18 +969,52 @@ class PagedGenerationServer:
                 (req.t_admit - req.t_submit) * 1e3
             )
             slot = heapq.heappop(self._free_slots)
-            self._reserved += pages_needed
             # Prefix sharing: start the table on the cached prefix's
             # read-only pages and evict LRU registry pins (never the
-            # matched entry) until the free list covers this request's
+            # donor entry) until the free list covers this request's
             # full PRIVATE budget — so later grows can never starve on
-            # registry-held pages.
-            key, shared, shared_tokens = self._prefix_lookup(req.prompt)
+            # registry-held pages. A host-tier match deeper than the
+            # HBM one promotes first (best-effort: promotion can never
+            # fail the admission — it falls back to the HBM match).
+            donor, shared, shared_tokens, host_node = \
+                self._prefix_lookup(req.prompt)
+            if host_node is not None:
+                got = self._promote_host_locked(host_node, {donor})
+                if got is not None:
+                    donor, shared, shared_tokens = got
+            page = self._cache.page_size
+            partial = shared_tokens % page != 0
+            shared_full = tuple(shared[:-1] if partial else shared)
+            private = pages_needed - len(shared_full)
+            self._reserved += private
+            self._lease_take_locked(shared_full)
+            req.pages_reserved = private
+            req.shared_pages = shared_full
+            if shared_full:
+                # The trie node at the full-shared depth: the journal's
+                # shadow key. For a partial (COW) match the donor is
+                # one level deeper — its parent is the shared path.
+                req.prefix_node = (
+                    self._prefix_nodes[donor]["parent"][0]
+                    if partial else donor
+                )
             try:
-                self._evict_prefixes_for(pages_needed - len(shared), key)
+                self._evict_prefixes_for(private, {donor})
                 self._cache.admit(slot, len(req.prompt), shared)
+                if partial:
+                    # COW divergence (rung 24a): the donor's partial
+                    # last page is shared too — copy it device-side
+                    # BEFORE the suffix prefill writes into it, so the
+                    # registry's original stays immutable. The copy is
+                    # within the private budget (it was priced as
+                    # owned, never leased).
+                    if self._cache.cow_page(slot, len(shared) - 1) \
+                            is not None:
+                        self._prefix_cow_copies += 1
             except Exception:
-                self._release_locked(slot, pages_needed)
+                self._release_locked(slot, private, req.shared_pages)
+                req.shared_pages = ()
+                req.prefix_node = None
                 raise
             self._prefilling += 1
             if shared_tokens:
@@ -967,7 +1084,10 @@ class PagedGenerationServer:
             with self._work:
                 if not activated:
                     self._prefilling -= 1
-                    self._release_locked(slot, pages_needed)
+                    self._release_locked(slot, req.pages_reserved,
+                                         req.shared_pages)
+                    req.shared_pages = ()
+                    req.prefix_node = None
                 if (isinstance(e, ServingFailure)
                         and not e.retryable):
                     # A terminal failure on the SUBMIT path (the op
@@ -1094,18 +1214,25 @@ class PagedGenerationServer:
             saved_len = len(req.prompt) + len(req.generated)
             n_pages = -(-saved_len // self._cache.page_size)
             ids = self._cache.slot_pages(slot)[:n_pages]
-            arrays = self._cache.swapout_pages(ids)
-            entry = JournalEntry(
-                req=req, pclass=req.pclass, ticket_no=req.ticket_no,
-                admit_seq=req.admit_seq,
-                pages_reserved=req.pages_reserved,
-                saved_len=saved_len, gen_len=len(req.generated),
-                next_token=req.next_token,
-                emitted=len(req.generated),
-                arrays=arrays,
-                nbytes=sum(a.nbytes for a in arrays),
-            )
-            if self._journal.put(req, entry):
+            sh_n = len(req.shared_pages)
+            if req.prefix_node is not None and sh_n:
+                ok = self._checkpoint_shared_locked(
+                    req, ids, saved_len, n_pages)
+            else:
+                arrays = self._cache.swapout_pages(ids)
+                entry = JournalEntry(
+                    req=req, pclass=req.pclass,
+                    ticket_no=req.ticket_no,
+                    admit_seq=req.admit_seq,
+                    pages_reserved=req.pages_reserved,
+                    saved_len=saved_len, gen_len=len(req.generated),
+                    next_token=req.next_token,
+                    emitted=len(req.generated),
+                    arrays=arrays,
+                    nbytes=sum(a.nbytes for a in arrays),
+                )
+                ok = self._journal.put(req, entry)
+            if ok:
                 self._checkpoints_total += 1
             else:
                 # Budget-refused: the request keeps its previous
@@ -1120,6 +1247,68 @@ class PagedGenerationServer:
                       "entries": len(self._journal),
                       "bytes": self._journal.nbytes},
             )
+
+    def _checkpoint_shared_locked(self, req: _Request, ids,
+                                  saved_len: int,
+                                  n_pages: int) -> bool:
+        """Checkpoint a request whose table starts on cached-prefix
+        pages (lock held): the entry carries only the request's OWN
+        page bytes plus a REFERENCE (trie node id + page/token depth)
+        into a per-node shadow snapshot of the shared bytes, taken
+        once and refcounted across every entry that cites it — N
+        requests on one system prompt bill the journal budget 1 shadow
+        + N suffixes, not N full copies (rung 24c). Refs bump BEFORE
+        ``put`` so the on_drop of a replaced older entry (which fires
+        inside ``put``) nets correctly when both cite the same node."""
+        node = req.prefix_node
+        sh_n = len(req.shared_pages)
+        own = self._cache.swapout_pages(ids[sh_n:])
+        shadow = self._prefix_shadow.get(node)
+        extra = 0
+        if shadow is None:
+            sh_arrays = self._cache.swapout_pages(ids[:sh_n])
+            extra = sum(a.nbytes for a in sh_arrays)
+            shadow = {"arrays": sh_arrays, "nbytes": extra,
+                      "refs": 0, "npages": sh_n}
+            self._prefix_shadow[node] = shadow
+        shadow["refs"] += 1
+        entry = JournalEntry(
+            req=req, pclass=req.pclass, ticket_no=req.ticket_no,
+            admit_seq=req.admit_seq,
+            pages_reserved=req.pages_reserved,
+            saved_len=saved_len, gen_len=len(req.generated),
+            next_token=req.next_token, emitted=len(req.generated),
+            arrays=own, nbytes=sum(a.nbytes for a in own),
+            prefix_node=node, prefix_pages_n=sh_n,
+            prefix_tokens=sh_n * self._cache.page_size,
+        )
+        if self._journal.put(req, entry, extra=extra):
+            if extra:
+                self._journal.adjust_extra(extra)
+            return True
+        shadow["refs"] -= 1
+        if shadow["refs"] <= 0:
+            # Freshly created for this refused entry — unwind it
+            # without billing (extra was never adjusted in).
+            del self._prefix_shadow[node]
+        return False
+
+    def _journal_drop_locked(self, entry) -> None:
+        """Journal entry-drop observer (lock held, wired to
+        ``RequestJournal.on_drop``): settle a dropped entry's prefix
+        reference — the last citation of a shadow snapshot releases
+        its bytes from the budget. Fires on put-replacement and pop;
+        restore settles drained entries itself after re-admission."""
+        node = entry.prefix_node
+        if node is None:
+            return
+        shadow = self._prefix_shadow.get(node)
+        if shadow is None:
+            return
+        shadow["refs"] -= 1
+        if shadow["refs"] <= 0:
+            del self._prefix_shadow[node]
+            self._journal.adjust_extra(-shadow["nbytes"])
 
     def _audit_pages_locked(self) -> None:
         """Assert page conservation at a quiescent boundary (lock
@@ -1189,6 +1378,7 @@ class PagedGenerationServer:
         of a pool that will never be revived. Without this, diverted
         requests would park forever behind a teardown."""
         for entry in self._journal.take_all():
+            self._journal_drop_locked(entry)
             req = entry.req
             if req.done.is_set():
                 continue
@@ -1271,48 +1461,103 @@ class PagedGenerationServer:
     # ---- prefix sharing (lock held for every method here) ----------------
 
     def _prefix_lookup(self, prompt: list[int]):
-        """(node_id, pages, shared_tokens) of the longest registered
-        page-aligned prefix — capped at len(prompt)-1 so at least one
-        token prefills and produces the first-emission logits. One walk
-        down the block trie: O(len(prompt)) hashing."""
+        """(donor_node, pages, shared_tokens, host_node) of the best
+        cached prefix — capped at len(prompt)-1 so at least one token
+        prefills and produces the first-emission logits.
+
+        The walk matches whole page-sized blocks down the radix trie;
+        from the deepest walked node it then tries a PARTIAL last
+        block against the children's HBM entries (COW divergence,
+        rung 24a): an entry whose next block shares >= 1 leading token
+        with the remaining prompt lends its partial page too — the
+        admission path copies that page device-side before the suffix
+        prefill writes into it. ``donor_node`` is the entry whose
+        pages are borrowed (the admission's eviction keep-set).
+        ``host_node`` is the deepest host-resident entry STRICTLY
+        deeper than the HBM match (rung 24b) — admission promotes it
+        when it can. One walk: O(len(prompt)) hashing."""
         if not self._prefix_enabled:
-            return None, (), 0
+            return None, (), 0, None
         page = self._cache.page_size
-        node, best = 0, (None, (), 0)
+        node, depth = 0, 0
+        best = (None, (), 0)
+        host = None
         for k in range(1, (len(prompt) - 1) // page + 1):
             block = tuple(prompt[(k - 1) * page:k * page])
-            child = self._prefix_children.get((node, block))
+            child = self._prefix_nodes[node]["edges"].get(block)
             if child is None:
                 break
-            node = child
-            entry = self._prefix_nodes[node]["entry"]
-            if entry is not None:
-                entry["last_used"] = time.monotonic()
-                best = (node, tuple(entry["pages"]), k * page)
-        return best
+            node, depth = child, k
+            rec = self._prefix_nodes[node]
+            if rec["entry"] is not None:
+                rec["entry"]["last_used"] = time.monotonic()
+                best = (node, tuple(rec["entry"]["pages"]), k * page)
+            if rec["host"] is not None:
+                host = (node, k)
+        cap = len(prompt) - 1 - depth * page
+        if cap > 0:
+            tail = prompt[depth * page:(depth + 1) * page]
+            best_ov = 0
+            for block, child in (
+                    self._prefix_nodes[node]["edges"].items()):
+                entry = self._prefix_nodes[child]["entry"]
+                if entry is None:
+                    continue
+                ov = 0
+                for a, b in zip(tail, block):
+                    if a != b:
+                        break
+                    ov += 1
+                ov = min(ov, cap)
+                if ov > best_ov:
+                    best_ov = ov
+                    entry["last_used"] = time.monotonic()
+                    best = (child,
+                            tuple(entry["pages"][:depth + 1]),
+                            depth * page + ov)
+        host_node = None
+        if host is not None and host[1] * page > best[2]:
+            host_node = host[0]
+        return best[0], best[1], best[2], host_node
+
+    def _admission_price_locked(self, pages_needed: int, shared,
+                                shared_tokens: int) -> int:
+        """The MARGINAL page cost of admitting an arrival whose prefix
+        lookup matched ``shared`` (lock held): its private budget (a
+        partially-shared page's COW copy counts as private) plus one
+        lease unit per full shared page no live request leases yet.
+        This is what the low-watermark shed and the park-loop capacity
+        clause gate on — shared pages already resident and leased are
+        free to admit against (rung 24)."""
+        page = self._cache.page_size
+        full = (shared[:-1] if shared and shared_tokens % page
+                else shared)
+        new_leases = sum(1 for p in full if p not in self._lease)
+        return pages_needed - len(full) + new_leases
 
     def _trie_child(self, node: int, block: tuple) -> int:
         """The trie child for ``block`` under ``node``, created if
         absent (lock held) — the ONE node-allocation walk step, shared
         by live registration and the persistence loader."""
-        child = self._prefix_children.get((node, block))
+        child = self._prefix_nodes[node]["edges"].get(block)
         if child is None:
             child = self._prefix_next_id
             self._prefix_next_id += 1
-            self._prefix_children[(node, block)] = child
+            self._prefix_nodes[node]["edges"][block] = child
             self._prefix_nodes[child] = {
-                "parent": (node, block), "children": 0, "entry": None,
+                "parent": (node, block), "edges": {}, "entry": None,
+                "host": None,
             }
-            self._prefix_nodes[node]["children"] += 1
         return child
 
     def _register_prefixes(self, prompt: list[int],
                            pages: list[int]) -> None:
-        """Pin every page-aligned prefix of a fully-prefilled prompt.
-        Only full pages covered entirely by PROMPT tokens register —
-        decode writes land past the prompt (the first grow opens a
-        fresh page even at an aligned boundary), so registered pages
-        are immutable. One walk down the trie: O(len(prompt))."""
+        """Pin every page-aligned prefix of committed token state.
+        Only full pages covered entirely by the given tokens register
+        — later writes land past them (the first grow opens a fresh
+        page even at an aligned boundary, and a shared partial page
+        COWs before its first write), so registered pages are
+        immutable. One walk down the trie: O(len(prompt))."""
         if not self._prefix_enabled:
             return
         page = self._cache.page_size
@@ -1327,50 +1572,177 @@ class PagedGenerationServer:
                 self._prefix_nodes[node]["entry"] = entry
                 self._prefix_entry_nodes[node] = entry
                 self._prefix_registrations += 1
+                if self._prefix_nodes[node]["host"] is not None:
+                    # A live registration supersedes a host-tier copy
+                    # of the same prefix (K/V are deterministic — the
+                    # bytes are identical); keeping both would double-
+                    # bill the host budget.
+                    self._drop_host_record_locked(node)
 
-    def _evict_prefix_node(self, node: int) -> None:
-        """Unpin one entry and prune upward while nodes are childless
-        and entry-less — the trie never outlives its entries."""
-        entry = self._prefix_entry_nodes.pop(node)
-        self._prefix_nodes[node]["entry"] = None
-        self._cache.release_pages(entry["pages"])
+    def _insert_prefix_entry(self, tokens: list[int],
+                             pages) -> int:
+        """Attach ONE registry entry holding ``pages`` at the trie
+        node for ``tokens`` (a whole number of blocks), creating path
+        nodes as needed (lock held). Ownership transfers: the caller's
+        page refs (``allocate_pinned_page``) BECOME the registry pin —
+        no extra retain — exactly the host-promotion idiom. Used by
+        the journal restore to resurrect a shadow snapshot's shared
+        pages as a live cache entry. Returns the node id."""
+        page = self._cache.page_size
+        node = 0
+        for k in range(1, len(tokens) // page + 1):
+            node = self._trie_child(
+                node, tuple(tokens[(k - 1) * page:k * page]))
+        if self._prefix_nodes[node]["entry"] is not None:
+            # Already live (another path resurrected it first): the
+            # existing pin wins, the caller's refs return to the pool.
+            self._cache.release_pages(pages)
+            return node
+        entry = {"pages": list(pages), "last_used": time.monotonic()}
+        self._prefix_nodes[node]["entry"] = entry
+        self._prefix_entry_nodes[node] = entry
+        self._prefix_registrations += 1
+        if self._prefix_nodes[node]["host"] is not None:
+            self._drop_host_record_locked(node)
+        return node
+
+    def _prune_prefix_upward(self, node: int) -> None:
+        """Prune edge-less, entry-less, host-less nodes upward (lock
+        held) — the trie never outlives its residents."""
         cur = node
-        while (cur != 0 and self._prefix_nodes[cur]["entry"] is None
-               and self._prefix_nodes[cur]["children"] == 0):
-            parent_key = self._prefix_nodes.pop(cur)["parent"]
-            del self._prefix_children[parent_key]
-            cur = parent_key[0]
-            self._prefix_nodes[cur]["children"] -= 1
+        while cur != 0:
+            rec = self._prefix_nodes[cur]
+            if (rec["entry"] is not None or rec["host"] is not None
+                    or rec["edges"]):
+                break
+            pid, block = rec["parent"]
+            del self._prefix_nodes[cur]
+            del self._prefix_nodes[pid]["edges"][block]
+            cur = pid
 
-    def _evict_prefixes_for(self, needed_free: int, keep) -> None:
-        """Evict LRU registry entries (never ``keep``) until the free
-        list can cover ``needed_free`` pages. Always sufficient for an
-        admission within its reservation: every non-registry allocation
-        sits inside some request's reserved budget, and reservations
-        never exceed the pool."""
+    def _evict_prefix_node(self, node: int, cause: str) -> None:
+        """Unpin one HBM entry — demoting its bytes to the host tier
+        when the budget allows (rung 24b) — and prune upward. The
+        low-watermark/pressure story this implements: cold SHARED
+        pages leave HBM (to host, not to nowhere) before any unique
+        live victim is preempted, because registry pins are always
+        relieved ahead of the preemption path seeing starvation.
+        ``cause`` feeds the eviction-by-cause counters; "revive" never
+        demotes — the device is suspect after a poison, so only the
+        emergency dump's host bytes are trusted."""
+        entry = self._prefix_entry_nodes.pop(node)
+        self._prefix_evictions[cause] += 1
+        rec = self._prefix_nodes[node]
+        rec["entry"] = None
+        if (self._prefix_host_budget and cause != "revive"
+                and rec["host"] is None):
+            self._demote_prefix_locked(node, entry)
+        self._cache.release_pages(entry["pages"])
+        self._prune_prefix_upward(node)
+
+    def _demote_prefix_locked(self, node: int, entry: dict) -> None:
+        """Swap an evicted entry's pages to the host tier (lock held):
+        the same verbatim as-stored bytes preemption uses (int8 scale
+        slabs ride along). Oversize records drop; host-LRU eviction
+        makes room otherwise. Best-effort — a failing device gather
+        (poisoned pool mid-relief) drops the entry instead of failing
+        the caller."""
+        try:
+            arrays = self._cache.swapout_pages(entry["pages"])
+        except Exception:
+            return
+        nbytes = sum(a.nbytes for a in arrays)
+        if nbytes > self._prefix_host_budget:
+            self._prefix_evictions["host_over"] += 1
+            return
+        while (self._prefix_host_bytes + nbytes
+               > self._prefix_host_budget):
+            lru = min(
+                self._prefix_host_nodes,
+                key=lambda n: self._prefix_host_nodes[n]["last_used"],
+            )
+            self._prefix_evictions["host_lru"] += 1
+            self._drop_host_record_locked(lru)
+        rec = {"arrays": arrays, "nbytes": nbytes,
+               "npages": len(entry["pages"]),
+               "last_used": entry["last_used"]}
+        self._prefix_nodes[node]["host"] = rec
+        self._prefix_host_nodes[node] = rec
+        self._prefix_host_bytes += nbytes
+        self._prefix_demotions += 1
+
+    def _drop_host_record_locked(self, node: int) -> None:
+        """Forget a host-tier record and un-bill its bytes (lock
+        held), pruning the trie path if nothing else holds it."""
+        rec = self._prefix_host_nodes.pop(node)
+        self._prefix_host_bytes -= rec["nbytes"]
+        self._prefix_nodes[node]["host"] = None
+        self._prune_prefix_upward(node)
+
+    def _promote_host_locked(self, node: int, keep) -> tuple | None:
+        """Swap a host-resident prefix entry back into HBM at an
+        admission hit (rung 24b). Returns the promoted
+        (node, pages, shared_tokens), or None — promotion is
+        best-effort and must NEVER fail the admission, which falls
+        back to the shallower HBM match. Fresh pages come from the
+        pinned allocator after an LRU sweep of colder HBM entries
+        (never ``keep``); if the free list still cannot cover the
+        record, the promotion simply doesn't happen."""
+        rec = self._prefix_host_nodes.get(node)
+        if rec is None:
+            return None
+        n = rec["npages"]
+        self._evict_prefixes_for(n, keep)
+        if self._cache.free_pages() < n:
+            return None
+        pages = [self._cache.allocate_pinned_page() for _ in range(n)]
+        try:
+            self._cache.swapin_pages(pages, rec["arrays"])
+        except Exception:
+            self._cache.release_pages(pages)
+            raise
+        entry = {"pages": pages, "last_used": time.monotonic()}
+        self._prefix_nodes[node]["entry"] = entry
+        self._prefix_entry_nodes[node] = entry
+        self._prefix_nodes[node]["host"] = None
+        self._prefix_host_nodes.pop(node)
+        self._prefix_host_bytes -= rec["nbytes"]
+        self._prefix_promotions += 1
+        self._prefix_registrations += 1
+        return node, tuple(pages), n * self._cache.page_size
+
+    def _evict_prefixes_for(self, needed_free: int, keep=()) -> None:
+        """Evict LRU registry entries (never one in ``keep``) until
+        the free list can cover ``needed_free`` pages. Always
+        sufficient for an admission within its reservation: every
+        non-registry allocation sits inside some request's reserved
+        budget (or a lease), and reservations never exceed the pool."""
         while (self._cache.free_pages() < needed_free
-               and any(n != keep for n in self._prefix_entry_nodes)):
+               and any(n not in keep
+                       for n in self._prefix_entry_nodes)):
             victim = min(
-                (n for n in self._prefix_entry_nodes if n != keep),
+                (n for n in self._prefix_entry_nodes if n not in keep),
                 key=lambda n: self._prefix_entry_nodes[n]["last_used"],
             )
-            self._evict_prefix_node(victim)
+            self._evict_prefix_node(victim, "admission")
 
-    def _relieve_pool_pressure(self, needed: int = 1) -> bool:
+    def _relieve_pool_pressure_locked(self, needed: int = 1) -> bool:
         """Cache callback when an allocation finds the free list short
-        (kvcache.grow/admit): registry pins sit outside every request's
-        reservation, so a mid-decode grow — which IS within its
-        request's reservation — must be able to reclaim them; after all
-        pins are dropped, free >= every in-reservation need. Runs under
-        the server lock (every cache call holds it). Returns True iff
-        ``needed`` pages are now free."""
+        (kvcache.grow/admit/cow): registry pins sit outside every
+        request's reservation, so a mid-decode grow — which IS within
+        its request's reservation — must be able to reclaim them;
+        after all pins are dropped, free >= every in-reservation need.
+        Eviction demotes to the host tier when configured, so relief
+        moves cold shared pages out of HBM instead of destroying them.
+        Runs under the server lock (every cache call holds it).
+        Returns True iff ``needed`` pages are now free."""
         while (self._prefix_entry_nodes
                and self._cache.free_pages() < needed):
             victim = min(
                 self._prefix_entry_nodes,
                 key=lambda n: self._prefix_entry_nodes[n]["last_used"],
             )
-            self._evict_prefix_node(victim)
+            self._evict_prefix_node(victim, "pressure")
         return self._cache.free_pages() >= needed
 
     # ---- prefix persistence ---------------------------------------------
@@ -1511,20 +1883,6 @@ class PagedGenerationServer:
                     ids, pool_k[:, pos], pool_v[:, pos]
                 )
         return loaded
-
-    def _insert_prefix_entry(self, tokens: list[int],
-                             pages: list[int]) -> None:
-        """Create the trie path for ``tokens`` and attach an entry
-        holding ``pages`` (lock held; refs already owned)."""
-        page = self._cache.page_size
-        node = 0
-        for k in range(1, len(tokens) // page + 1):
-            node = self._trie_child(
-                node, tuple(tokens[(k - 1) * page:k * page])
-            )
-        entry = {"pages": pages, "last_used": time.monotonic()}
-        self._prefix_nodes[node]["entry"] = entry
-        self._prefix_entry_nodes[node] = entry
 
     def start_prefix_persistence(self, path: str, fingerprint: str,
                                  interval: float = 30.0) -> None:
@@ -1881,12 +2239,17 @@ class PagedGenerationServer:
                     )
                 self._work.wait(timeout=left)
             for node in list(self._prefix_entry_nodes):
-                self._evict_prefix_node(node)
+                # "revive" never demotes: device K/V are suspect after
+                # a poison. The host tier and the journal's shadow
+                # snapshots are host bytes taken BEFORE the failure —
+                # they survive and stay trusted.
+                self._evict_prefix_node(node, "revive")
             for slot in range(self._cache.slots):
                 if self._cache.is_admitted(slot):
                     self._cache.release(slot)
             self._free_slots = list(range(self._cache.slots))
             self._reserved = 0
+            self._lease.clear()
             self._bucket_step_wanted = False
             self._active.clear()
             # The failing loop drained its in-flight window before
@@ -1941,8 +2304,20 @@ class PagedGenerationServer:
         the delivered watermark arms ``_emit``'s replay suppression —
         then takes a fresh slot with the verbatim page bytes swapped
         back in. The rewind is idempotent, so the failure path can
-        re-journal already-restored entries and retry wholesale."""
+        re-journal already-restored entries and retry wholesale.
+
+        Prefix-reference entries (rung 24c) re-materialize the shared
+        bytes ONCE per cited node: the first restorer swaps the shadow
+        snapshot into freshly pinned pages and resurrects the registry
+        entry, every later citer of the same node re-leases those
+        pages via ``admit(shared=...)`` — N conversations on one
+        system prompt swap in 1 prefix + N suffixes. Shadow refs
+        settle only after the WHOLE restore commits; the unwind
+        re-puts entries with refs untouched, so a retry still finds
+        its shadows."""
         entries = self._journal.take_all()
+        all_drained = list(entries)
+        node_pages: dict[int, tuple] = {}
         restored: list[tuple[int, JournalEntry]] = []
         t0 = time.perf_counter()
         try:
@@ -1968,6 +2343,8 @@ class PagedGenerationServer:
                 req.pages_reserved = entry.pages_reserved
                 req.ticket_no = entry.ticket_no
                 req.admit_seq = entry.admit_seq
+                req.shared_pages = ()
+                req.prefix_node = None
                 slot = heapq.heappop(self._free_slots)
                 self._reserved += entry.pages_reserved
                 self._active[slot] = req
@@ -1976,18 +2353,50 @@ class PagedGenerationServer:
                 # the unwind below (the entry is then briefly in both
                 # lists — the double re-journal is a same-key replace).
                 restored.append((slot, entry))
-                self._cache.admit(slot, entry.saved_len)
-                self._cache.swapin_pages(
-                    self._cache.slot_pages(slot), entry.arrays
-                )
+                sh_n = entry.prefix_pages_n
+                if entry.prefix_node is not None and sh_n:
+                    node = entry.prefix_node
+                    pins = node_pages.get(node)
+                    if pins is None:
+                        shadow = self._prefix_shadow[node]
+                        fresh = [self._cache.allocate_pinned_page()
+                                 for _ in range(sh_n)]
+                        try:
+                            self._cache.swapin_pages(
+                                fresh, shadow["arrays"])
+                        except Exception:
+                            self._cache.release_pages(fresh)
+                            raise
+                        self._insert_prefix_entry(
+                            req.prompt[:entry.prefix_tokens], fresh)
+                        pins = node_pages[node] = tuple(fresh)
+                    self._cache.admit(slot, entry.saved_len, pins)
+                    self._cache.swapin_pages(
+                        self._cache.slot_pages(slot)[sh_n:],
+                        entry.arrays,
+                    )
+                    self._lease_take_locked(pins)
+                    req.shared_pages = pins
+                    req.prefix_node = node
+                else:
+                    self._cache.admit(slot, entry.saved_len)
+                    self._cache.swapin_pages(
+                        self._cache.slot_pages(slot), entry.arrays
+                    )
                 entries.pop(0)
         except Exception:
             # Transactional unwind: put everything back — restored
             # rows included (their rewind is idempotent) — so the next
-            # revive attempt loses nothing.
+            # revive attempt loses nothing. Shadow refs are NOT
+            # settled (the re-put entries still cite them); registry
+            # entries resurrected above stay until the next revive's
+            # scrub evicts them.
             for slot, entry in restored:
                 self._active.pop(slot, None)
-                self._release_locked(slot, entry.pages_reserved)
+                self._release_locked(slot, entry.pages_reserved,
+                                     entry.req.shared_pages)
+                entry.req.shared_pages = ()
+                entry.req.prefix_node = None
             for _, entry in restored:
                 self._journal.put(entry.req, entry)
             for entry in entries:
@@ -1996,7 +2405,10 @@ class PagedGenerationServer:
         # Slot-overflow checkpoints go back to the SWAP SET under their
         # original tickets (host bookkeeping only — cannot fault): the
         # decode loop resumes them at boundaries exactly like preempted
-        # victims, ahead of post-revive arrivals.
+        # victims, ahead of post-revive arrivals. Prefix-reference
+        # entries materialize the FULL byte snapshot here (shadow
+        # prefix + own suffix, page axis 1) — a swapped-out request
+        # has no live pages to lease, so its resume is self-contained.
         requeued = 0
         for entry in entries:
             req = entry.req
@@ -2006,12 +2418,30 @@ class PagedGenerationServer:
             req.next_token = entry.next_token
             req.inflight = 0
             req.stopped = False
+            arrays = entry.arrays
+            pages_needed = entry.pages_reserved
+            if entry.prefix_node is not None and entry.prefix_pages_n:
+                shadow = self._prefix_shadow[entry.prefix_node]
+                arrays = tuple(
+                    np.concatenate([s, o], axis=1)
+                    for s, o in zip(shadow["arrays"], entry.arrays)
+                )
+                pages_needed += entry.prefix_pages_n
+            req.pages_reserved = pages_needed
+            req.shared_pages = ()
+            req.prefix_node = None
             self._sched.record_swapout_locked(
                 req, entry.pclass, entry.ticket_no,
-                entry.pages_reserved, entry.saved_len, entry.arrays,
+                pages_needed, entry.saved_len, arrays,
                 restore=True,
             )
             requeued += 1
+        # Full success: settle every drained entry's shadow reference
+        # — restored requests will re-cite at their next checkpoint,
+        # requeued ones became self-contained above.
+        for entry in all_drained:
+            if entry.prefix_node is not None:
+                self._journal_drop_locked(entry)
         self._journal_restores += len(restored) + requeued
         if self.tracer is not None and (restored or requeued):
             self.tracer.span(
@@ -2043,7 +2473,24 @@ class PagedGenerationServer:
                              else str(self._cfg.dtype)),
                 "prefix_entries": len(self._prefix_entry_nodes),
                 "prefix_hits": self._prefix_hits,
+                "prefix_lookups": self._prefix_lookups,
                 "prefix_tokens_saved": self._prefix_tokens_saved,
+                # Prefix-cache semantics (SERVING.md rung 24): COW
+                # divergence copies, HBM bytes the shared prefixes
+                # avoided re-prefilling, the host residency tier, and
+                # evictions by cause (one labelled counter in
+                # /metrics).
+                "prefix_bytes_saved": self._prefix_tokens_saved * (
+                    self._page_bytes_locked()
+                    // self._cache.page_size),
+                "prefix_cow_copies": self._prefix_cow_copies,
+                "prefix_host_entries": len(self._prefix_host_nodes),
+                "prefix_host_bytes": self._prefix_host_bytes,
+                "prefix_demotions": self._prefix_demotions,
+                "prefix_promotions": self._prefix_promotions,
+                "prefix_evictions": dict(self._prefix_evictions),
+                "journal_shadow_nodes": len(self._prefix_shadow),
+                "journal_shadow_bytes": self._journal.extra_bytes,
                 "overlap": 1 if self._overlap_on else 0,
                 "overlap_windows_total": self._overlap_windows,
                 "overlap_inflight_depth":
@@ -2117,12 +2564,37 @@ class PagedGenerationServer:
 
     # ---- decode loop -----------------------------------------------------
 
-    def _release_locked(self, slot: int, pages_needed: int) -> None:
-        """Return a slot + its reservation to the pool (lock held)."""
+    def _lease_take_locked(self, pages) -> None:
+        """Acquire one live-sharer lease per page (lock held). The
+        FIRST sharer of a page books its one reservation unit; later
+        sharers ride the existing lease for free (rung 24)."""
+        for p in pages:
+            n = self._lease.get(p, 0)
+            self._lease[p] = n + 1
+            if n == 0:
+                self._reserved += 1
+
+    def _lease_drop_locked(self, pages) -> None:
+        """Release leases (lock held): a page's reservation unit frees
+        only when its LAST live sharer leaves."""
+        for p in pages:
+            n = self._lease[p] - 1
+            if n:
+                self._lease[p] = n
+            else:
+                del self._lease[p]
+                self._reserved -= 1
+
+    def _release_locked(self, slot: int, pages_needed: int,
+                        shared: tuple = ()) -> None:
+        """Return a slot + its reservation to the pool (lock held).
+        ``pages_needed`` is the request's PRIVATE reservation;
+        ``shared`` drops its prefix-page leases too."""
         if self._cache.is_admitted(slot):
             self._cache.release(slot)
         heapq.heappush(self._free_slots, slot)
         self._reserved -= pages_needed
+        self._lease_drop_locked(shared)
         # Targeted admission wakeup: the policy head (and ONLY the
         # head) re-checks capacity; the work condition still fans out
         # to the decode loop (which may now resume a swapped request).
@@ -2145,7 +2617,21 @@ class PagedGenerationServer:
             )
         del self._active[slot]
         self._journal.pop(req)  # a finished request never resumes
-        self._release_locked(slot, self._pages_for(req))
+        if self._prefix_enabled:
+            # Multi-turn reuse (rung 24a): the finished slot's
+            # committed K/V — prompt AND generated — is exact reusable
+            # prefix state (K/V at position i depend only on tokens
+            # 0..i), so a follow-up turn whose prompt embeds this
+            # conversation hits. Registered before the release drops
+            # the page refs; clamped to the committed device length so
+            # a deferred stop can never register scribbled positions.
+            tokens = (req.prompt + req.generated)[
+                :self._cache.slot_length(slot)]
+            self._register_prefixes(
+                tokens, self._cache.slot_pages(slot)
+            )
+        self._release_locked(slot, self._pages_for(req),
+                             req.shared_pages)
         if req.stream is not None:
             req.stream.put(_STREAM_DONE)
         req.done.set()
@@ -2379,7 +2865,8 @@ class PagedGenerationServer:
                 continue
             del self._active[slot]
             self._journal.pop(req)  # a cancelled request never resumes
-            self._release_locked(slot, self._pages_for(req))
+            self._release_locked(slot, self._pages_for(req),
+                                 req.shared_pages)
             req.error = RequestCancelled(
                 "request cancelled mid-decode"
             )
@@ -2479,17 +2966,23 @@ class PagedGenerationServer:
         the pipeline-collapse probe must predict the boundary-time
         cost, or it can collapse the pipeline for a victim whose
         grown snapshot the budget then declines — a wasted collapse."""
+        n_tokens = len(req.prompt) + len(req.generated)
+        if include_inflight:
+            n_tokens += req.inflight
+        n_pages = -(-n_tokens // self._cache.page_size)
+        return n_pages * self._page_bytes_locked()
+
+    def _page_bytes_locked(self) -> int:
+        """Host bytes one KV page occupies (lock held; lazy — the
+        pool's slab shapes are fixed at boot). Shared by swap-cost
+        pricing and the prefix bytes-saved gauge."""
         if self._swap_page_bytes is None:
             st = self._cache.state
             per = st.pool_k.nbytes + st.pool_v.nbytes
             if st.scale_k is not None:
                 per += st.scale_k.nbytes + st.scale_v.nbytes
             self._swap_page_bytes = -(-per // self._cache.num_pages)
-        n_tokens = len(req.prompt) + len(req.generated)
-        if include_inflight:
-            n_tokens += req.inflight
-        n_pages = -(-n_tokens // self._cache.page_size)
-        return n_pages * self._swap_page_bytes
+        return self._swap_page_bytes
 
     def _pick_victim_locked(self, head, *,
                             ignore_inflight: bool = False) -> int | None:
@@ -2594,9 +3087,21 @@ class PagedGenerationServer:
             ids = self._cache.slot_pages(victim)[:n_pages]
             arrays = self._cache.swapout_pages(ids)
             del self._active[victim]
-            self._release_locked(victim, req.pages_reserved)
+            # A preempted victim becomes SELF-CONTAINED: the verbatim
+            # gather above copied its shared-prefix pages too, so its
+            # leases dissolve and the resume prices (and later
+            # re-reserves) the full footprint. Conservative — a resume
+            # could in principle re-match the trie — but a resume that
+            # cannot depend on cache state is a resume that always
+            # fits its books.
+            full = req.pages_reserved + len(req.shared_pages)
+            self._release_locked(victim, req.pages_reserved,
+                                 req.shared_pages)
+            req.pages_reserved = full
+            req.shared_pages = ()
+            req.prefix_node = None
             self._sched.record_swapout_locked(
-                req, req.pclass, req.ticket_no, req.pages_reserved,
+                req, req.pclass, req.ticket_no, full,
                 saved_len, arrays,
             )
 

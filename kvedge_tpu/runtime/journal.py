@@ -58,6 +58,16 @@ class JournalEntry:
     emitted: int
     arrays: tuple = field(repr=False)
     nbytes: int = 0
+    # Prefix refcounting (rung 24): a request whose table starts on
+    # cached-prefix pages journals a REFERENCE to the shared bytes —
+    # ``prefix_node`` is the trie node id whose shadow snapshot holds
+    # the first ``prefix_pages_n`` pages (``prefix_tokens`` prompt
+    # tokens), and ``arrays``/``nbytes`` then cover only the request's
+    # OWN pages. None = self-contained full-bytes entry (the pre-rung
+    # format; also the fallback when the shadow would blow the budget).
+    prefix_node: int | None = None
+    prefix_pages_n: int = 0
+    prefix_tokens: int = 0
 
 
 class RequestJournal:
@@ -72,6 +82,15 @@ class RequestJournal:
         self.max_bytes = int(max_bytes)
         self._entries: dict[Hashable, JournalEntry] = {}
         self._nbytes = 0
+        self._extra = 0
+        # Entry-drop observer (rung 24): called for each entry that
+        # leaves the journal via replacement (``put`` over an old
+        # entry) or ``pop`` — NOT via ``take_all``, whose caller takes
+        # ownership of the drained entries and settles their prefix
+        # references itself after restore. The serving layer hangs its
+        # shadow-store refcount decrement here so a dropped reference
+        # can release the shared bytes it billed.
+        self.on_drop = None
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -81,29 +100,53 @@ class RequestJournal:
 
     @property
     def nbytes(self) -> int:
-        return self._nbytes
+        return self._nbytes + self._extra
+
+    @property
+    def extra_bytes(self) -> int:
+        return self._extra
+
+    def adjust_extra(self, delta: int) -> None:
+        """Bill (or release, negative) out-of-entry bytes against the
+        budget — the shared prefix shadow snapshots, which back many
+        entries but must count ONCE. The caller adjusts at shadow
+        create/drop; ``put`` prices new entries against the total."""
+        self._extra += int(delta)
+        if self._extra < 0:
+            raise ValueError("journal extra bytes went negative")
 
     def get(self, key: Hashable) -> JournalEntry | None:
         return self._entries.get(key)
 
-    def put(self, key: Hashable, entry: JournalEntry) -> bool:
-        """Replace ``key``'s entry. False (and no change) on budget."""
+    def put(self, key: Hashable, entry: JournalEntry,
+            extra: int = 0) -> bool:
+        """Replace ``key``'s entry. False (and no change) on budget.
+        ``extra`` prices shadow bytes this entry would NEWLY pin (0
+        when the shadow already exists); on success the caller then
+        bills them via :meth:`adjust_extra`."""
         old = self._entries.get(key)
         freed = old.nbytes if old is not None else 0
-        if self.max_bytes and self._nbytes - freed + entry.nbytes > self.max_bytes:
+        if self.max_bytes and (self._nbytes + self._extra - freed
+                               + entry.nbytes + extra > self.max_bytes):
             return False
         self._nbytes += entry.nbytes - freed
         self._entries[key] = entry
+        if old is not None and self.on_drop is not None:
+            self.on_drop(old)
         return True
 
     def pop(self, key: Hashable) -> JournalEntry | None:
         entry = self._entries.pop(key, None)
         if entry is not None:
             self._nbytes -= entry.nbytes
+            if self.on_drop is not None:
+                self.on_drop(entry)
         return entry
 
     def take_all(self) -> list[JournalEntry]:
-        """Drain every entry, oldest ticket first (admission order)."""
+        """Drain every entry, oldest ticket first (admission order).
+        Ownership transfers: ``on_drop`` does NOT fire — the caller
+        settles each entry's prefix references after restoring it."""
         entries = sorted(self._entries.values(),
                          key=lambda e: (e.admit_seq, e.ticket_no))
         self._entries.clear()

@@ -66,6 +66,7 @@ from kvedge_tpu.models.kvcache import (
     PagedCacheError,
     PagedKVCache,
     PagedState,
+    _cow_page_impl,
     _decode_step_core,
     _gather_pages_impl,
     _paged_decode_window_capped_impl,
@@ -87,7 +88,7 @@ from kvedge_tpu.models.kvcache import (
 # at the end: the numbering is wire protocol.
 (OP_STOP, OP_SYNC, OP_PREFILL, OP_STEP, OP_WINDOW, OP_SPEC,
  OP_WSAMPLE, OP_WINDOWP, OP_WSAMPLEP, OP_SWAPOUT, OP_SWAPIN,
- OP_SPECW, OP_SPECWS, OP_MULTI) = range(14)
+ OP_SPECW, OP_SPECWS, OP_MULTI, OP_COWP) = range(15)
 _HEADER_LEN = 4  # [op, a, b, c] — meanings per op below.
 
 # Human names for follower-side replay spans (runtime/tracing.py).
@@ -97,7 +98,7 @@ _OP_NAMES = {
     OP_WSAMPLE: "wsample", OP_WINDOWP: "windowp",
     OP_WSAMPLEP: "wsamplep", OP_SWAPOUT: "swapout",
     OP_SWAPIN: "swapin", OP_SPECW: "specw", OP_SPECWS: "specws",
-    OP_MULTI: "multi",
+    OP_MULTI: "multi", OP_COWP: "cowp",
 }
 
 # Ops whose payloads may ride a coalesced OP_MULTI frame (SERVING.md
@@ -108,6 +109,7 @@ _OP_NAMES = {
 # carve a packed frame without any out-of-band shape agreement.
 _COALESCABLE = frozenset((
     OP_SYNC, OP_SWAPIN, OP_WINDOWP, OP_WSAMPLEP, OP_SPECW, OP_SPECWS,
+    OP_COWP,
 ))
 
 
@@ -204,9 +206,22 @@ def _slice_kernels(mesh, cfg, quantized: bool = False):
     swap_scatter = jax.jit(
         _scatter_pages_impl, donate_argnums=(0,), out_shardings=state_sh,
     )
+    # COW divergence (SERVING.md rung 24): one device-side page copy
+    # per (src, dst) pair, traced ONCE — the pair arrives as a traced
+    # [2] int32 array so every copy replays the same program. Each
+    # process copies its own head shard; nothing crosses hosts.
+    cow = jax.jit(
+        _cow_pair_core, donate_argnums=(0,), out_shardings=state_sh,
+    )
     return (rep, state_sh, prefill, step, window, spec, wsample,
             window_capped, wsample_capped, swap_gather, swap_scatter,
-            specw, specws)
+            specw, specws, cow)
+
+
+def _cow_pair_core(state, pair):
+    """Header-derived form of :func:`_cow_page_impl` for the op
+    stream: ``pair = [src, dst]`` rides the broadcast as one array."""
+    return _cow_page_impl(state, pair[0], pair[1])
 
 
 class SlicePagedKVCache(PagedKVCache):
@@ -245,7 +260,8 @@ class SlicePagedKVCache(PagedKVCache):
          self._k_window, self._k_spec, self._k_wsample,
          self._k_window_capped, self._k_wsample_capped,
          self._k_swapout, self._k_swapin,
-         self._k_specw, self._k_specws) = _slice_kernels(
+         self._k_specw, self._k_specws,
+         self._k_cow) = _slice_kernels(
              mesh, cfg, quantized=kv_dtype == "int8"
          )
         self._is_leader = jax.process_index() == 0
@@ -434,6 +450,10 @@ class SlicePagedKVCache(PagedKVCache):
             return tuple(
                 (arr.shape, arr.dtype) for arr in self._swap_templates(a)
             )
+        if op == OP_COWP:
+            # a = src, b = dst (redundantly carried in the [2] int32
+            # payload so the jitted copy replays one traced program).
+            return (((2,), np.int32),)
         if op == OP_WINDOWP:
             # a = n_steps, b = carry flag.
             return (((n,), np.int32), ((n,), bool), ((n,), np.int32),
@@ -469,6 +489,8 @@ class SlicePagedKVCache(PagedKVCache):
             self._apply_sync(payload[0], payload[1])
         elif op == OP_SWAPIN:
             self._exec_swapin(payload[0], tuple(payload[1:]))
+        elif op == OP_COWP:
+            self._exec_cow(np.asarray(payload[0]))
         elif op == OP_WINDOWP:
             self._exec_window_pipelined(
                 params, *payload, n_steps=a, carry=bool(b))
@@ -818,6 +840,24 @@ class SlicePagedKVCache(PagedKVCache):
         self.state = self._k_swapin(
             self.state, self._global(ids.astype(np.int32)),
             tuple(self._global(a) for a in arrays),
+        )
+
+    def _device_cow(self, src: int, dst: int) -> None:
+        """Leader: broadcast the (src, dst) pair, then every process
+        runs the same jitted page copy on its own pool shard. Deferred
+        like a swap-in (rung 23): the COW at an admission rides the
+        next flush's frame with the table sync and prefill dispatch
+        that follow it, so divergence costs no extra collective."""
+        self._check_live()
+        pair = np.asarray([src, dst], np.int32)
+        self._queue_op(
+            (OP_COWP, int(src), int(dst), 0), (pair,),
+            lambda: self._exec_cow(pair),
+        )
+
+    def _exec_cow(self, pair: np.ndarray) -> None:
+        self.state = self._k_cow(
+            self.state, self._global(pair.astype(np.int32))
         )
 
     def _swap_templates(self, n: int) -> tuple:

@@ -116,6 +116,38 @@ _SERVE_METRIC_FIELDS = (
     ("prefix_tokens_saved", "serve_prefix_tokens_saved_total", "counter",
      "prompt tokens whose prefill was skipped via prefix sharing "
      "(paged backend)"),
+    # Copy-on-write radix prefix cache (SERVING.md rung 24): hit rate
+    # (hits / lookups), HBM bytes the sharing avoided recomputing, COW
+    # divergence copies, and the tiered host residency gauges.
+    ("prefix_lookups", "serve_prefix_lookups_total", "counter",
+     "admission-time prefix-cache lookups — hit rate is "
+     "serve_prefix_hits_total / this (paged backend)"),
+    ("prefix_bytes_saved", "serve_prefix_bytes_saved_total", "counter",
+     "KV-pool bytes the shared prefix pages avoided re-prefilling "
+     "(tokens_saved x per-token page bytes; paged backend)"),
+    ("prefix_cow_copies", "serve_prefix_cow_copies_total", "counter",
+     "device-side copy-on-write page copies taken when an admission "
+     "shared a partially-matching last page (paged backend)"),
+    ("prefix_host_entries", "serve_prefix_host_entries", "gauge",
+     "prefix entries resident in the host RAM tier "
+     "(serving_prefix_host_mb; paged backend)"),
+    ("prefix_host_bytes", "serve_prefix_host_bytes", "gauge",
+     "host RAM bytes held by demoted prefix entries, counted against "
+     "serving_prefix_host_mb (paged backend)"),
+    ("prefix_demotions", "serve_prefix_demotions_total", "counter",
+     "prefix entries demoted HBM -> host tier on eviction "
+     "(paged backend)"),
+    ("prefix_promotions", "serve_prefix_promotions_total", "counter",
+     "host-resident prefix entries swapped back into HBM at an "
+     "admission hit (paged backend)"),
+    # Journal refcounts (rung 24c): shadow snapshots of shared prefix
+    # bytes cited by (not duplicated into) checkpoint entries.
+    ("journal_shadow_nodes", "serve_journal_shadow_nodes", "gauge",
+     "shared-prefix shadow snapshots the journal holds — each backs "
+     "one or more checkpoint entries by reference (paged backend)"),
+    ("journal_shadow_bytes", "serve_journal_shadow_bytes", "gauge",
+     "host RAM bytes held by shared-prefix shadow snapshots, counted "
+     "ONCE against the journal budget however many entries cite them"),
     ("window", "serve_window", "gauge",
      "device decode window cap in steps (paged backend, "
      "serving_window)"),
@@ -387,6 +419,21 @@ def render_metrics(snapshot: dict) -> str:
         for cause in sorted(fallbacks):
             lines.append(
                 f'{name}{{cause="{cause}"}} {fallbacks[cause]}')
+    # Prefix-cache evictions by cause (rung 24): admission = LRU sweep
+    # to fit an arrival; pressure = mid-decode pool-relief callback;
+    # revive = post-poison scrub (device bytes untrusted, never
+    # demoted); host_lru / host_over = host-tier budget evictions.
+    evictions = serving.get("prefix_evictions")
+    if isinstance(evictions, dict) and evictions:
+        name = "kvedge_serve_prefix_evictions_total"
+        lines.append(
+            f"# HELP {name} prefix-cache entries evicted from their "
+            "tier, by cause (admission/pressure/revive = HBM "
+            "entries; host_lru/host_over = host-tier records)")
+        lines.append(f"# TYPE {name} counter")
+        for cause in sorted(evictions):
+            lines.append(
+                f'{name}{{cause="{cause}"}} {evictions[cause]}')
     for key, suffix, help_text in _SERVE_HISTOGRAM_FIELDS:
         hist = serving.get(key)
         if isinstance(hist, dict):

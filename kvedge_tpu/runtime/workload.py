@@ -1396,6 +1396,7 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 page_size=page_size,
                 prefill_chunk=cfg.serving_prefill_chunk,
                 prefix_cache=cfg.serving_prefix_cache,
+                prefix_host_mb=cfg.serving_prefix_host_mb,
                 speculative=spec_draft,
                 # Device-resident spec windows (SERVING.md rung 20):
                 # only meaningful when spec_draft resolved > 0 — the

@@ -16,6 +16,15 @@ wherever the seam counter happens to fall), heals every poison with
    free once the round's requests settle. The server's own
    ``debug_pages`` audit runs at every quiescent boundary during the
    round, so a transient leak poisons loudly instead of hiding.
+   With the shared-prefix mix (``prefix_mix=True``) the check is
+   REFCOUNT-AWARE: registry-pinned pages are legitimately live after
+   settle, so conservation becomes ``free + |distinct pinned pages|
+   == pages_total`` (a page shared by several entries counts ONCE),
+   every pinned page's refcount must equal exactly the number of
+   entries holding it (no leaked retains after poison/revive or a
+   journal-refcount restore), the journal's shadow store must be
+   empty, and force-evicting the whole registry must return the pool
+   to every-page-free.
 2. **No stuck tickets** — every submission terminates (tokens or a
    typed error) within the round's deadline; the journal and the
    active set are empty once the round settles.
@@ -109,7 +118,7 @@ def run_chaos_campaign(params, tcfg, seed: int, *, rounds: int = 2,
                        page_size: int = 4, vocab: int | None = None,
                        prompt_len: tuple = (3, 7),
                        config: dict | None = None, oracle=None,
-                       wound=None,
+                       wound=None, prefix_mix: bool = False,
                        join_timeout_s: float = 180.0) -> ChaosResult:
     """Run one seeded campaign against a fresh server; raise
     :class:`~kvedge_tpu.testing.faults.InvariantViolation` (carrying
@@ -122,7 +131,11 @@ def run_chaos_campaign(params, tcfg, seed: int, *, rounds: int = 2,
     from ``models.generate`` per prompt. ``wound(round_i, server,
     cache, plan)`` runs after each round's plan is armed — the hook
     slice/capacity tests use to compose extra damage (follower loss,
-    bucket pressure) on top of the seam fault.
+    bucket pressure) on top of the seam fault. ``prefix_mix`` turns
+    the prefix cache ON and draws prompts from a small set of shared
+    page-sized stems, so faults land on COW admissions, leased pages,
+    and journal-refcount checkpoints — the settle check then runs the
+    refcount-aware conservation invariant (docstring point 1).
     """
     from kvedge_tpu.models.serving import (
         PagedGenerationServer,
@@ -153,14 +166,24 @@ def run_chaos_campaign(params, tcfg, seed: int, *, rounds: int = 2,
     vocab = vocab or tcfg.vocab
     cache = FaultyCache(tcfg, slots=slots, pages=pages,
                         page_size=page_size)
-    # prefix_cache off: pinned prefix pages are LEGITIMATELY live
-    # across requests, which would poison invariant 1's every-page-free
-    # check — and prefix reuse is orthogonal to the durability story
-    # this soak exists to break.
+    # Default mix runs prefix_cache off: pinned prefix pages are
+    # LEGITIMATELY live across requests, which would poison the plain
+    # every-page-free check — and prefix reuse is orthogonal to the
+    # basic durability story. ``prefix_mix`` flips it on and switches
+    # the settle check to the refcount-aware invariant.
     server = PagedGenerationServer(
-        params, tcfg, cache=cache, prefix_cache=False,
+        params, tcfg, cache=cache, prefix_cache=prefix_mix,
         debug_pages=True, **cfg_draw,
     )
+    stems = []
+    if prefix_mix:
+        # Two fixed page-multiple stems (so full-block trie hits) the
+        # seeded prompts below share; suffixes diverge mid-page too,
+        # exercising the COW path.
+        stems = [
+            [rng.randrange(1, vocab) for _ in range(page_size)],
+            [rng.randrange(1, vocab) for _ in range(2 * page_size)],
+        ]
 
     def fail(msg):
         raise InvariantViolation(f"[chaos seed={seed}] {msg}", trace)
@@ -187,6 +210,8 @@ def run_chaos_campaign(params, tcfg, seed: int, *, rounds: int = 2,
             for _ in range(requests_per_round):
                 prompt = [rng.randrange(1, vocab)
                           for _ in range(rng.randrange(*prompt_len))]
+                if prefix_mix and rng.random() < 0.75:
+                    prompt = rng.choice(stems) + prompt
                 subs.append(_Sub(
                     prompt=prompt, n_new=n_new,
                     streaming=rng.random() < 0.5,
@@ -304,20 +329,57 @@ def _drive(server, sub: _Sub) -> None:
 
 def _check_settled(server, cache, fail, *, context: str) -> None:
     """Invariants 1 + 2 once a round's requests have all terminated:
-    balanced books with every page free, no journal residue, nothing
-    still admitted."""
+    balanced books, no journal residue, nothing still admitted. With
+    the prefix cache off, every page must be free; with it on, the
+    REFCOUNT-AWARE form applies — registry pins are the only
+    legitimate holds, each counted once however many entries share
+    it, each page's refcount exactly the holding-entry count, the
+    journal's shadow store empty, and a full force-evict returns the
+    pool to every-page-free (no leaked retains or leases)."""
     acct = cache.page_accounting()
     ok = (acct["free"] + acct["live"] == acct["pages_total"]
           and not acct["free_dup"] and not acct["neg_refs"]
           and not acct["free_live"])
     if not ok:
         fail(f"{context}: page books broken after settle: {acct}")
-    if acct["free"] != acct["pages_total"]:
-        fail(f"{context}: pages leaked after settle: {acct}")
+    with server._lock:
+        holds: dict = {}
+        for entry in server._prefix_entry_nodes.values():
+            for p in entry["pages"]:
+                holds[p] = holds.get(p, 0) + 1
+        leases = dict(server._lease)
+        shadow_nodes = len(server._prefix_shadow)
+    if leases:
+        fail(f"{context}: leases leaked after settle: {leases}")
+    if acct["free"] + len(holds) != acct["pages_total"]:
+        fail(f"{context}: pages leaked after settle "
+             f"(free={acct['free']} pinned={len(holds)} "
+             f"total={acct['pages_total']})")
+    for p in range(acct["pages_total"]):
+        want = holds.get(p, 0)
+        got = cache.page_refcount(p)
+        if got != want:
+            fail(f"{context}: page {p} refcount {got} != "
+                 f"{want} holding entries — leaked retain")
     stats = server.stats()
     if stats.get("journal_entries"):
         fail(f"{context}: journal residue after settle: "
              f"{stats['journal_entries']} entries")
+    if shadow_nodes or stats.get("journal_shadow_bytes"):
+        fail(f"{context}: shadow residue after settle: "
+             f"{shadow_nodes} nodes, "
+             f"{stats.get('journal_shadow_bytes')} bytes")
     if stats.get("in_flight"):
         fail(f"{context}: {stats['in_flight']} requests still "
              "admitted after settle")
+    # The pins themselves must release cleanly: force-evict the whole
+    # registry (and the host tier) and require every page free.
+    if holds:
+        with server._lock:
+            for node in list(server._prefix_entry_nodes):
+                server._evict_prefix_node(node, "pressure")
+            for node in list(server._prefix_host_nodes):
+                server._drop_host_record_locked(node)
+        if cache.free_pages() != acct["pages_total"]:
+            fail(f"{context}: {acct['pages_total'] - cache.free_pages()}"
+                 f" pages still held after force-evicting the registry")

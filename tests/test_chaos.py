@@ -135,6 +135,44 @@ def test_campaign_decisions_replay_from_seed(params, oracle):
     assert a.completed + a.failed == b.completed + b.failed
 
 
+# ---- shared-prefix mix: refcount-aware conservation (rung 24) ------------
+
+
+@pytest.mark.prefix
+@pytest.mark.parametrize(
+    "seed,config",
+    [(2, SERIAL), (9, SERIAL), (2, OVERLAP)],
+    ids=["serial-2", "serial-9", "overlap-2"],
+)
+def test_prefix_mix_campaign(params, oracle, seed, config):
+    """Chaos with the prefix cache ON and prompts sharing page-sized
+    stems: faults land on COW admissions, leased pages, and
+    journal-refcount checkpoints. The settle check runs the
+    refcount-aware conservation invariant — shared pages counted once,
+    per-page refcounts equal to the holding-entry count, shadow store
+    empty, force-evict returning the pool to every-page-free — and
+    every completion still matches the fault-free oracle."""
+    res = run_chaos_campaign(
+        params, CFG, seed=seed, rounds=ROUNDS,
+        requests_per_round=PER_ROUND, n_new=6, config=config,
+        oracle=oracle, prefix_mix=True,
+    )
+    assert res.completed + res.failed == ROUNDS * PER_ROUND
+    assert res.revives >= 1, res.fired
+
+
+@pytest.mark.prefix
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(200, 212))
+def test_prefix_mix_soak(params, oracle, seed):
+    res = run_chaos_campaign(
+        params, CFG, seed=seed, rounds=ROUNDS,
+        requests_per_round=PER_ROUND, n_new=6, oracle=oracle,
+        prefix_mix=True,
+    )
+    assert res.completed + res.failed == ROUNDS * PER_ROUND
+
+
 # ---- the seeded soak (slow): drawn shapes, >= 20 campaigns ---------------
 
 

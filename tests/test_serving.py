@@ -427,7 +427,9 @@ def test_prefix_sharing_exact_and_skips_shared_prefill(params):
         first = server.submit(base + [4, 6], n_new=4)
         assert first == reference(params, base + [4, 6], 4)
         stats = server.stats()
-        assert stats["prefix_entries"] == 2  # 1-page and 2-page prefixes
+        # 1-, 2-, and 3-page prefixes: finish registers the COMMITTED
+        # tokens (prompt + generated, 14 here), not just the prompt.
+        assert stats["prefix_entries"] == 3
         assert stats["prefix_hits"] == 0
 
         calls.clear()
@@ -470,21 +472,24 @@ def test_prefix_pins_evict_under_pool_pressure(params):
     server = PagedGenerationServer(params, CFG, slots=1, pages=6,
                                    page_size=4)
     try:
-        a = [1, 2, 3, 4, 5, 6, 7, 8]  # 2 pages, both prefixes registered
+        a = [1, 2, 3, 4, 5, 6, 7, 8]  # 2 full committed pages
         assert server.submit(a, n_new=4) == reference(params, a, 4)
+        # Committed length is 11 (the final emitted token is never fed
+        # back), so 2 full pages register.
         assert server.stats()["prefix_entries"] == 2
-        # After A's release the registry pins its 2 prompt pages, so 4
-        # of 6 pages are free. B (unrelated prompt) needs
+        # After A's release the registry pins its 2 committed pages, so
+        # 4 of 6 pages are free. B (unrelated prompt) needs
         # ceil((8+12)/4) = 5 pages: admission must evict A's pins and
         # proceed.
         b = [9, 9, 8, 8, 7, 7, 6, 6]
         assert server.submit(b, n_new=12) == reference(params, b, 12)
         # A's prefixes were evicted (a lookup for them finds nothing)...
-        _, _, shared = server._prefix_lookup(a + [0])
+        _, _, shared, _ = server._prefix_lookup(a + [0])
         assert shared == 0
-        # ...and B's own prefixes registered after it completed.
-        assert server.stats()["prefix_entries"] == 2
-        _, _, shared = server._prefix_lookup(b + [0])
+        # ...and B's own prefixes (19 committed tokens, 4 full pages)
+        # registered after it completed.
+        assert server.stats()["prefix_entries"] == 4
+        _, _, shared, _ = server._prefix_lookup(b + [0])
         assert shared == 8
     finally:
         server.close()
@@ -506,7 +511,7 @@ def test_grow_under_registry_pressure_evicts_instead_of_poisoning(params):
     server = PagedGenerationServer(params, CFG, slots=2, pages=18,
                                    page_size=4, window=4)
     relief_calls = [0]
-    orig_relief = server._relieve_pool_pressure
+    orig_relief = server._relieve_pool_pressure_locked
 
     def counting_relief(needed=1):
         relief_calls[0] += 1
@@ -763,7 +768,9 @@ def test_prefix_cache_dump_load_round_trip(params, tmp_path):
                                    page_size=4)
     try:
         warm = server.submit(base + [4, 6], n_new=4)
-        assert server.dump_prefix_cache(path, "fp-1") == 2
+        # 13 committed tokens (prompt + 3 fed-back generated): 1-, 2-,
+        # and 3-page prefixes registered and dumped.
+        assert server.dump_prefix_cache(path, "fp-1") == 3
     finally:
         server.close()
 
@@ -778,16 +785,19 @@ def test_prefix_cache_dump_load_round_trip(params, tmp_path):
 
     revived._cache.prefill_chunk = counting_chunk
     try:
-        assert revived.load_prefix_cache(path, "fp-1") == 2
+        assert revived.load_prefix_cache(path, "fp-1") == 3
         stats = revived.stats()
-        assert stats["prefix_entries"] == 2
+        assert stats["prefix_entries"] == 3
         got = revived.submit(base + [4, 6], n_new=4)
         assert got == warm == reference(params, base + [4, 6], 4)
-        # Only the 2-token suffix prefilled: the 8 prefix tokens came
-        # off the persisted pages.
-        assert calls == [(8, 2)], calls
+        # 9 tokens came off the persisted pages: the 8 full-block
+        # tokens PLUS one token of the 3-page entry's partial last
+        # block ([4, 6, ...] — capped at len(prompt)-1), which the
+        # admission COW-copied before prefilling the final token.
+        assert calls == [(9, 1)], calls
         assert revived.stats()["prefix_hits"] == 1
-        assert revived.stats()["prefix_tokens_saved"] == 8
+        assert revived.stats()["prefix_tokens_saved"] == 9
+        assert revived.stats()["prefix_cow_copies"] == 1
     finally:
         revived.close()
 
@@ -801,9 +811,9 @@ def test_prefix_cache_load_rejects_stale_and_respects_capacity(
     server = PagedGenerationServer(params, CFG, slots=2, pages=24,
                                    page_size=4)
     try:
-        server.submit([1, 1, 1, 1, 9], n_new=4)           # 1-page entry
-        server.submit([2, 2, 2, 2, 3, 3, 3, 3, 9], n_new=4)  # 1pg + 2pg
-        assert server.dump_prefix_cache(path, "fp-1") == 3
+        server.submit([1, 1, 1, 1, 9], n_new=4)           # 2 entries
+        server.submit([2, 2, 2, 2, 3, 3, 3, 3, 9], n_new=4)  # 3 entries
+        assert server.dump_prefix_cache(path, "fp-1") == 5
     finally:
         server.close()
 
@@ -842,7 +852,7 @@ def test_prefix_cache_load_is_boot_time_only(params, tmp_path):
                                    page_size=4)
     try:
         server.submit([7, 3, 9, 1, 5], n_new=4)
-        assert server.dump_prefix_cache(path, "fp-1") == 1
+        assert server.dump_prefix_cache(path, "fp-1") == 2
         # Live registry present: a (second) load must refuse — it would
         # double-pin shared pages.
         assert server.load_prefix_cache(path, "fp-1") == 0

@@ -41,6 +41,8 @@ def main() -> int:
                     help="skip the (slowest) open-loop arrivals leg")
     ap.add_argument("--skip-overload", action="store_true",
                     help="skip the closed-loop scheduler overload leg")
+    ap.add_argument("--skip-prefix", action="store_true",
+                    help="skip the shared-prefix open-loop leg")
     args = ap.parse_args()
 
     import pathlib
@@ -144,6 +146,32 @@ def main() -> int:
                     lg["wait_p99_ms"]
                 for (cap, mode, rate), lg in openloop["legs"].items()
             },
+        })
+
+    if not args.skip_prefix:
+        prefix_ol = leg("prefix_openloop", lambda: (
+            bench.measure_prefix_openloop(gqa, bench.PAGED_PAGE_SIZE)))
+        out.update({
+            "prefix_openloop_requests": prefix_ol["requests"],
+            "prefix_openloop_rate_req_per_sec": round(
+                prefix_ol["rate_req_per_sec"], 2),
+            "prefix_openloop_bit_identical":
+                prefix_ol["bit_identical"],
+            "prefix_openloop_prefill_tokens_saved":
+                prefix_ol["on"]["prefill_tokens_saved"],
+            "prefix_openloop_prefill_saved_frac": round(
+                prefix_ol["saved_frac"], 3),
+            "prefix_openloop_cow_copies": prefix_ol["on"]["cow_copies"],
+            "prefix_openloop_goodput_tokens_per_sec": round(
+                prefix_ol["on"]["goodput_tokens_per_sec"], 1),
+            "prefix_openloop_off_goodput_tokens_per_sec": round(
+                prefix_ol["off"]["goodput_tokens_per_sec"], 1),
+            "prefix_openloop_ttft_p50_ms": prefix_ol["on"]["ttft_p50_ms"],
+            "prefix_openloop_off_ttft_p50_ms":
+                prefix_ol["off"]["ttft_p50_ms"],
+            "prefix_openloop_ttft_p99_ms": prefix_ol["on"]["ttft_p99_ms"],
+            "prefix_openloop_off_ttft_p99_ms":
+                prefix_ol["off"]["ttft_p99_ms"],
         })
 
     print(json.dumps(out))

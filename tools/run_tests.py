@@ -58,6 +58,12 @@ Usage::
                                          # finishes, composed with
                                          # preempt/revive/buckets);
                                          # fast, tier-1
+    python tools/run_tests.py --prefix   # only the prefix-cache tests
+                                         # (-m prefix: COW divergence,
+                                         # tiered host residency,
+                                         # journal refcounts, shared-
+                                         # prefix chaos); deterministic
+                                         # subset tier-1, soaks slow
     python tools/run_tests.py --lint     # lock-discipline gate: runs
                                          # tools/locklint.py over the
                                          # package (fast-fails on any
@@ -226,6 +232,12 @@ def main(argv: list[str] | None = None) -> int:
                          "composition tests (forwards -m endgame: "
                          "sampled spec windows, device stop finishes, "
                          "composed with preempt/revive/bucketing)")
+    ap.add_argument("--prefix", action="store_true",
+                    help="run only the prefix-cache tests (forwards "
+                         "-m prefix: COW divergence, tiered host "
+                         "residency, journal refcounts, and — without "
+                         "the tier-1 'not slow' filter — the shared-"
+                         "prefix chaos soak)")
     ap.add_argument("--lint", action="store_true",
                     help="run the lock-discipline gate: tools/locklint.py "
                          "over kvedge_tpu/, then the analyzer's own tests "
@@ -257,6 +269,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "chaos"]
     if args.endgame:
         args.pytest_args += ["-m", "endgame"]
+    if args.prefix:
+        args.pytest_args += ["-m", "prefix"]
     if args.lint:
         # The analyzer gate runs FIRST and fast-fails: a tree with
         # unsuppressed findings should not spend minutes in pytest
