@@ -1192,6 +1192,78 @@ def measure_trace_overhead(cfg, slots: int, prompt_len: int, n_new: int,
     return off, on
 
 
+def measure_obs_overhead(cfg, slots: int, prompt_len: int, n_new: int,
+                         page_size: int) -> tuple[float, float]:
+    """The rung-25 observability bill on the paged decode leg: the
+    same fully-loaded decode through the REAL server with the whole
+    stack off, then EVERYTHING on at once — full-sample tracing, the
+    SLO engine (snapshots every boundary the throttle admits), and the
+    occupancy timeline ring. Each boundary's marginal work is three
+    ``_Hist.snapshot()`` copies plus a deque append of O(1) gauges, so
+    the design contract is < 5% (pinned by tests/test_slo.py on the
+    checked-in bench doc).
+
+    Returns ``(tokens_per_sec_off, tokens_per_sec_on)``."""
+    import threading
+
+    from kvedge_tpu.models.serving import PagedGenerationServer
+    from kvedge_tpu.runtime.slo import SloObjectives
+    from kvedge_tpu.runtime.tracing import Tracer
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pages = slots * -(-(prompt_len + n_new) // page_size)
+    rng = np.random.default_rng(12)
+    prompts = rng.integers(
+        0, cfg.vocab, size=(slots, prompt_len)
+    ).astype(np.int32)
+
+    def run(obs: bool) -> float:
+        # A tight fast window pushes the SLO snapshot throttle to its
+        # floor (~0.03 s) so the measured run takes MORE boundary
+        # snapshots per second than any production config would.
+        extra = dict(
+            tracer=Tracer(sample=1.0), slo=SloObjectives(fast_window_s=1.0),
+            occupancy_ring=256,
+        ) if obs else {}
+        server = PagedGenerationServer(
+            params, cfg, slots=slots, pages=pages, page_size=page_size,
+            prefix_cache=False, window=PAGED_WINDOW, **extra,
+        )
+        errors: list[Exception] = []
+
+        def client(ci: int) -> None:
+            try:
+                server.submit([int(t) for t in prompts[ci]], n_new,
+                              timeout=600.0,
+                              request_id=f"bench-obs-{ci}")
+            except Exception as e:  # pragma: no cover - fail loudly
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(ci,), daemon=True)
+            for ci in range(slots)
+        ]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        server.close()
+        if errors:
+            raise errors[0]
+        return slots * n_new / elapsed
+
+    # Same interleaved best-of-3 protocol as measure_trace_overhead:
+    # warmup eats the compile, interleaving decorrelates host drift.
+    run(False)
+    off = on = 0.0
+    for _ in range(3):
+        off = max(off, run(False))
+        on = max(on, run(True))
+    return off, on
+
+
 CHECKPOINT_EVERY = 16
 
 
@@ -1549,6 +1621,9 @@ def main() -> int:
     trace_off_tps, trace_on_tps = measure_trace_overhead(
         gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE
     )
+    obs_off_tps, obs_on_tps = measure_obs_overhead(
+        gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE
+    )
     ckpt_off_tps, ckpt_on_tps = measure_checkpoint_overhead(
         gqa, PAGED_SLOTS, DECODE_PROMPT, DECODE_NEW, PAGED_PAGE_SIZE
     )
@@ -1782,6 +1857,18 @@ def main() -> int:
                 "paged_decode_trace_overhead_pct": round(
                     (trace_off_tps - trace_on_tps)
                     / trace_off_tps * 100.0, 2
+                ),
+                # Full observability bill (SERVING.md rung 25): the
+                # whole stack at once — full-sample tracing + SLO
+                # engine (throttle floored by a 1 s fast window) +
+                # occupancy ring — vs everything off. Contract < 5%;
+                # negative values are run-to-run noise.
+                "paged_decode_obs_on_tokens_per_sec": round(
+                    obs_on_tps, 1
+                ),
+                "paged_decode_obs_overhead_pct": round(
+                    (obs_off_tps - obs_on_tps)
+                    / obs_off_tps * 100.0, 2
                 ),
                 # Durability bill (SERVING.md rung 22): boundary
                 # checkpoints off vs the default cadence (16). Each

@@ -469,6 +469,46 @@ class RuntimeConfig:
     # a typed PageAccountingError — loud, attributable leak detection
     # for debug/test runs (the chaos soak runs with it on).
     serving_debug_pages: bool = False
+    # SLO engine (SERVING.md rung 25, runtime/slo.py): rolling
+    # multi-window SLIs (TTFT/inter-token/queue-wait p99, goodput,
+    # shed rate) computed from boundary-snapshot deltas of the
+    # cumulative serving histograms, with fast/slow-window error-
+    # budget burn-rate alerts. Off (default) = no engine in the
+    # process; on exposes GET /slo and the serve_slo_* gauges. Tokens
+    # are bit-identical either way (pinned by tests/test_slo.py).
+    serving_slo: bool = False
+    # Compliance target: the error budget is 1 - target; burn rate
+    # over a window is bad_fraction / (1 - target).
+    serving_slo_target: float = 0.99
+    # Latency objectives (ms): the per-window over-objective fraction
+    # of each is a bad-event fraction competing for the error budget.
+    serving_slo_ttft_ms: float = 1000.0
+    serving_slo_itl_ms: float = 250.0
+    serving_slo_queue_ms: float = 1000.0
+    # The multi-window burn-rate pair (seconds): the slow window
+    # proves an incident is real, the fast window proves it is still
+    # happening. Alert thresholds are the SRE-workbook constants
+    # (14x fast / 6x slow), not knobs.
+    serving_slo_fast_s: float = 60.0
+    serving_slo_slow_s: float = 600.0
+    # Burn-gated shedding: while the multi-window alert fires, the
+    # scheduler sheds non-top classes at the door. Off (default)
+    # keeps the rung-17 shed paths byte-for-byte; requires
+    # serving_slo.
+    serving_slo_shed: bool = False
+    # Flight-recorder bundle (rung 25): on poison the workload layer
+    # writes flight-bundle.json (one versioned document: metrics
+    # snapshot, SLO/burn state, occupancy tail, journal summary, page
+    # books, config fingerprint, trace tail) next to
+    # last-failure.json, and GET /debug/bundle serves the same
+    # document live. Off (default) = neither.
+    serving_bundle: bool = False
+    # Occupancy timeline ring depth (samples; 0 = off): HBM/page/
+    # bucket/prefix-residency gauges sampled at quiescent boundaries,
+    # exported as serve_occupancy_* gauges, Chrome counter tracks in
+    # GET /trace, and the bundle's occupancy tail. 256 is a
+    # reasonable depth when on.
+    serving_occupancy_ring: int = 0
     # The "train" payload: resumable training over a token corpus on the
     # state volume. ``train_corpus`` is the corpus path (required for the
     # payload; rebased like every other in-pod path); steps count from 0
@@ -681,6 +721,43 @@ class RuntimeConfig:
                 ),
                 serving_debug_pages=payload_doc.get(
                     "serving_debug_pages", cls.serving_debug_pages
+                ),
+                serving_slo=payload_doc.get(
+                    "serving_slo", cls.serving_slo
+                ),
+                serving_slo_target=float(
+                    payload_doc.get("serving_slo_target",
+                                    cls.serving_slo_target)
+                ),
+                serving_slo_ttft_ms=float(
+                    payload_doc.get("serving_slo_ttft_ms",
+                                    cls.serving_slo_ttft_ms)
+                ),
+                serving_slo_itl_ms=float(
+                    payload_doc.get("serving_slo_itl_ms",
+                                    cls.serving_slo_itl_ms)
+                ),
+                serving_slo_queue_ms=float(
+                    payload_doc.get("serving_slo_queue_ms",
+                                    cls.serving_slo_queue_ms)
+                ),
+                serving_slo_fast_s=float(
+                    payload_doc.get("serving_slo_fast_s",
+                                    cls.serving_slo_fast_s)
+                ),
+                serving_slo_slow_s=float(
+                    payload_doc.get("serving_slo_slow_s",
+                                    cls.serving_slo_slow_s)
+                ),
+                serving_slo_shed=payload_doc.get(
+                    "serving_slo_shed", cls.serving_slo_shed
+                ),
+                serving_bundle=payload_doc.get(
+                    "serving_bundle", cls.serving_bundle
+                ),
+                serving_occupancy_ring=int(
+                    payload_doc.get("serving_occupancy_ring",
+                                    cls.serving_occupancy_ring)
                 ),
                 train_corpus=str(
                     payload_doc.get("corpus", cls.train_corpus)
@@ -920,6 +997,43 @@ class RuntimeConfig:
             raise RuntimeConfigError(
                 "[payload] serving_debug_pages must be a boolean"
             )
+        for knob in ("serving_slo", "serving_slo_shed",
+                     "serving_bundle"):
+            if not isinstance(getattr(self, knob), bool):
+                raise RuntimeConfigError(
+                    f"[payload] {knob} must be a boolean"
+                )
+        if not 0.0 < self.serving_slo_target < 1.0:
+            raise RuntimeConfigError(
+                "[payload] serving_slo_target must be in (0, 1) "
+                f"(got {self.serving_slo_target!r}; the error budget "
+                "is 1 - target)"
+            )
+        for knob in ("serving_slo_ttft_ms", "serving_slo_itl_ms",
+                     "serving_slo_queue_ms"):
+            if getattr(self, knob) <= 0.0:
+                raise RuntimeConfigError(
+                    f"[payload] {knob} must be > 0 (an objective in "
+                    "milliseconds)"
+                )
+        if not (0.0 < self.serving_slo_fast_s
+                <= self.serving_slo_slow_s):
+            raise RuntimeConfigError(
+                "[payload] serving_slo windows must satisfy "
+                "0 < serving_slo_fast_s <= serving_slo_slow_s "
+                f"(got fast={self.serving_slo_fast_s!r}, "
+                f"slow={self.serving_slo_slow_s!r})"
+            )
+        if self.serving_slo_shed and not self.serving_slo:
+            raise RuntimeConfigError(
+                "[payload] serving_slo_shed requires serving_slo = "
+                "true (the burn-rate input comes from the SLO engine)"
+            )
+        if self.serving_occupancy_ring < 0:
+            raise RuntimeConfigError(
+                "[payload] serving_occupancy_ring must be >= 0 "
+                "(0 = off; otherwise the ring depth in samples)"
+            )
         if self.payload == "train" and not self.train_corpus:
             raise RuntimeConfigError(
                 "[payload] kind = 'train' requires corpus = '<path>' "
@@ -1030,6 +1144,19 @@ class RuntimeConfig:
             f"{self.serving_checkpoint_every}\n"
             "serving_debug_pages = "
             f"{'true' if self.serving_debug_pages else 'false'}\n"
+            f"serving_slo = {'true' if self.serving_slo else 'false'}\n"
+            f"serving_slo_target = {self.serving_slo_target}\n"
+            f"serving_slo_ttft_ms = {self.serving_slo_ttft_ms}\n"
+            f"serving_slo_itl_ms = {self.serving_slo_itl_ms}\n"
+            f"serving_slo_queue_ms = {self.serving_slo_queue_ms}\n"
+            f"serving_slo_fast_s = {self.serving_slo_fast_s}\n"
+            f"serving_slo_slow_s = {self.serving_slo_slow_s}\n"
+            "serving_slo_shed = "
+            f"{'true' if self.serving_slo_shed else 'false'}\n"
+            "serving_bundle = "
+            f"{'true' if self.serving_bundle else 'false'}\n"
+            "serving_occupancy_ring = "
+            f"{self.serving_occupancy_ring}\n"
             f"corpus = {s(self.train_corpus)}\n"
             f"eval_corpus = {s(self.eval_corpus)}\n"
             f"steps = {self.train_steps}\n"

@@ -241,6 +241,14 @@ class AdmissionScheduler:
         # the streak resets every time the true head admits.
         self.hot_bypasses = 0
         self._bypass_streak = 0
+        # Error-budget burn gate (SERVING.md rung 25, knob-gated via
+        # [payload] serving_slo_shed): a () -> bool the serving layer
+        # installs when the knob is on — True while the SLO engine's
+        # multi-window burn-rate alert fires, at which point non-top
+        # classes shed at the door (batch work is the error budget's
+        # cheapest relief valve). None (the default) keeps every shed
+        # path byte-for-byte the rung-17 one.
+        self.burn_input = None
 
     # ---- ranks & small queries ------------------------------------------
 
@@ -392,6 +400,16 @@ class AdmissionScheduler:
                                    f"({depth} tickets ahead of class "
                                    f"{pclass!r} >= watermark "
                                    f"{self.max_queue_depth})")
+        # Burn-rate gate (rung 25): while BOTH SLO burn windows run
+        # hot, protect the interactive error budget by shedding every
+        # lower class up front. The top class never burn-sheds — the
+        # alert exists to keep ITS latency inside objective.
+        if (self.burn_input is not None and self.rank(pclass) > 0
+                and self.burn_input()):
+            return self._note_shed(pclass, rid, est,
+                                   f"error-budget burn-rate alert is "
+                                   f"firing; class {pclass!r} sheds "
+                                   f"until the budget recovers")
         # Wait-based sheds only apply while same-class work is parked:
         # with an empty class queue the arrival becomes the class head
         # immediately, and letting it park is the only way the wait

@@ -25,7 +25,9 @@ capability the repo's own README listed as future work.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import heapq
+import json
 import queue
 import threading
 import time
@@ -174,6 +176,10 @@ class _Request:
     trace: bool = False
     t_submit: float = 0.0
     t_admit: float = 0.0
+    # First-token stamp (the TTFT observation instant): with the final
+    # finish stamp it yields the request's mean inter-token gap — the
+    # per-request ITL the rung-25 SLO engine computes its p99 over.
+    t_first: float = 0.0
     # Exactly-once delivery watermark (rung 22): tokens at indices
     # below this were already streamed to the consumer before a
     # journal restore rewound ``generated`` to the checkpoint —
@@ -267,7 +273,9 @@ class PagedGenerationServer:
                  checkpoint_every: int = 0,
                  journal_budget_mb: int = 0,
                  prefix_host_mb: int = 0,
-                 debug_pages: bool = False):
+                 debug_pages: bool = False,
+                 slo=None, slo_shed: bool = False,
+                 occupancy_ring: int = 0):
         from kvedge_tpu.models.kvcache import PagedKVCache
 
         self._params = params
@@ -331,6 +339,26 @@ class PagedGenerationServer:
         self._hist_ttft = _Hist(_stage_edges)
         self._hist_queue = _Hist(_stage_edges)
         self._hist_decode = _Hist(_stage_edges)
+        # Device-time attribution (SERVING.md rung 25): the forced
+        # device sync inside each window/harvest call, timed on its
+        # own. Subtracted from the dispatch->harvest RTT it proves
+        # where a regression lives — device kernel vs host bookkeeping
+        # vs transport. Same always-on contract as the stage hists:
+        # two perf_counter stamps per WINDOW, not per token.
+        self._hist_device = _Hist((1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+                                   100.0, 200.0, 500.0, 1000.0,
+                                   2000.0))
+        # Per-request mean inter-token gap, observed once at finish
+        # ((t_done - t_first) / (tokens - 1)) — the SLO engine's
+        # inter-token SLI input. Cheaper and tail-honest vs stamping
+        # every token: a stall inflates the request's mean.
+        self._hist_itl = _Hist((0.5, 1.0, 2.0, 5.0, 10.0, 20.0,
+                                50.0, 100.0, 200.0, 500.0))
+        # Completion counters (goodput / shed-rate SLIs): requests
+        # that finished NORMALLY and the generated tokens they
+        # realized. Cancels/failures don't count — goodput is good.
+        self._done_total = 0
+        self._tokens_done_total = 0
         # Speculative mode (draft length K, 0 = off): greedy slots
         # advance by batched verify passes — K prompt-lookup drafts per
         # slot, one (1+K)-query forward for the whole batch, up to K+1
@@ -637,6 +665,34 @@ class PagedGenerationServer:
         # drain must not report done — while any exist, or their
         # waiters would hang on a request no loop will ever serve.
         self._prefilling = 0
+        # SLO engine (runtime/slo.py, SERVING.md rung 25): rolling
+        # multi-window SLIs from deltas of the cumulative histograms
+        # above, fed one snapshot per quiescent boundary. None = off
+        # (the default) — the boundary feed guards on it, so off costs
+        # one attribute read per boundary and tokens are bit-identical.
+        self._slo = None
+        if slo is not None:
+            from kvedge_tpu.runtime.slo import SloEngine
+            self._slo = SloEngine(slo)
+            if slo_shed:
+                # Knob-gated burn-rate input to the rung-17 shed
+                # decision: while the multi-window alert fires,
+                # non-top classes shed at the door. Off by default —
+                # the scheduler's burn_input stays None and every
+                # shed path is byte-for-byte the rung-17 one.
+                self._sched.burn_input = self._slo.alert
+        elif slo_shed:
+            raise ValueError("slo_shed needs SLO objectives (slo=...)")
+        # Occupancy timeline ring (rung 25): HBM/page/bucket/prefix
+        # residency gauges sampled at quiescent boundaries. 0 = off.
+        # With tracing on, the ring doubles as the Chrome counter
+        # track source so Perfetto draws occupancy under the spans.
+        self._occ_ring = None
+        if occupancy_ring:
+            from kvedge_tpu.runtime.slo import OccupancyRing
+            self._occ_ring = OccupancyRing(occupancy_ring)
+            if tracer is not None:
+                tracer.counter_source = self._occ_ring.chrome_counters
         if debug_locks:
             # Wrap every bound *_locked method (server AND the
             # scheduler sharing its lock) to assert ownership at call
@@ -1060,7 +1116,10 @@ class PagedGenerationServer:
                 # Time to first token: submit -> the prefill logits'
                 # pick. This is the serving-visible TTFT (the first
                 # emission rides the next loop iteration, but the
-                # token is decided here).
+                # token is decided here). The stamp is kept on the
+                # request: finish pairs it with the final token for
+                # the per-request inter-token gap (rung 25).
+                req.t_first = t_first
                 self._hist_ttft.observe((t_first - req.t_submit) * 1e3)
                 if req.trace:
                     self.tracer.span(
@@ -2452,115 +2511,270 @@ class PagedGenerationServer:
 
     def stats(self) -> dict:
         with self._lock:
-            out = {
+            return self._stats_locked()
+
+    def _stats_locked(self) -> dict:
+        out = {
+            "degraded": 1 if self._degraded_reason else 0,
+            "in_flight": len(self._active),
+            "free_slots": len(self._free_slots),
+            "free_pages": self._cache.free_pages(),
+            "reserved_pages": self._reserved,
+            # Capacity semantics (SERVING.md rung 21): the page
+            # pool is the admission resource and the bucket is the
+            # device batch dim — the gauges an operator needs to
+            # see shed/preempt pressure coming.
+            "pages_total": self._pages_total,
+            "slots_total": self._cache.slots,
+            "bucket": self._cache.bucket,
+            "bucket_min": self._cache.min_bucket,
+            "page_low_watermark": self._page_low_wm,
+            "page_high_watermark": self._page_high_wm,
+            "window": self._window,
+            "kv_dtype": ("int8" if self._cache.kv_quantized
+                         else str(self._cfg.dtype)),
+            "prefix_entries": len(self._prefix_entry_nodes),
+            "prefix_hits": self._prefix_hits,
+            "prefix_lookups": self._prefix_lookups,
+            "prefix_tokens_saved": self._prefix_tokens_saved,
+            # Prefix-cache semantics (SERVING.md rung 24): COW
+            # divergence copies, HBM bytes the shared prefixes
+            # avoided re-prefilling, the host residency tier, and
+            # evictions by cause (one labelled counter in
+            # /metrics).
+            "prefix_bytes_saved": self._prefix_tokens_saved * (
+                self._page_bytes_locked()
+                // self._cache.page_size),
+            "prefix_cow_copies": self._prefix_cow_copies,
+            "prefix_host_entries": len(self._prefix_host_nodes),
+            "prefix_host_bytes": self._prefix_host_bytes,
+            "prefix_demotions": self._prefix_demotions,
+            "prefix_promotions": self._prefix_promotions,
+            "prefix_evictions": dict(self._prefix_evictions),
+            "journal_shadow_nodes": len(self._prefix_shadow),
+            "journal_shadow_bytes": self._journal.extra_bytes,
+            "overlap": 1 if self._overlap_on else 0,
+            "overlap_windows_total": self._overlap_windows,
+            "overlap_inflight_depth":
+                1 if self._inflight is not None else 0,
+            # Histogram snapshots (dict-valued; status.py renders
+            # them as Prometheus histograms, scalar consumers
+            # should skip them).
+            "window_dispatch_harvest_ms": self._hist_rtt.snapshot(),
+            "window_host_ms": self._hist_host.snapshot(),
+            # Device-time attribution (SERVING.md rung 25): the
+            # forced-sync leg of each window on its own, so RTT
+            # minus device is host bookkeeping + pipeline slack.
+            "window_device_ms": self._hist_device.snapshot(),
+            "window_inflight_depth": self._hist_depth.snapshot(),
+            # Per-request stage histograms (SERVING.md rung 18):
+            # TTFT and the queue-vs-decode split.
+            "ttft_ms": self._hist_ttft.snapshot(),
+            "queue_ms": self._hist_queue.snapshot(),
+            "decode_ms": self._hist_decode.snapshot(),
+            # Per-request mean inter-token gap + completion
+            # counters (rung 25 SLI inputs).
+            "itl_ms": self._hist_itl.snapshot(),
+            "requests_done_total": self._done_total,
+            "tokens_done_total": self._tokens_done_total,
+            # Durability semantics (SERVING.md rung 22): journal
+            # occupancy, checkpoint throughput, and the restores
+            # revive() performed — the gauges that prove in-flight
+            # requests are actually covered.
+            "checkpoint_every": self._checkpoint_every,
+            "journal_entries": len(self._journal),
+            "journal_bytes": self._journal.nbytes,
+            "checkpoints_total": self._checkpoints_total,
+            "checkpoint_skipped_total": self._checkpoint_skipped,
+            "journal_restores_total": self._journal_restores,
+            # Device-resident endgame (SERVING.md rung 23):
+            # windowed-path collapses by cause (rendered as one
+            # labelled Prometheus counter) and stop-token finishes.
+            "spec_window_fallbacks": dict(
+                self._spec_window_fallbacks
+            ),
+            "stop_finishes_total": self._stop_finishes,
+        }
+        if self.tracer is not None:
+            out.update(self.tracer.stats())
+        if self._slo is not None:
+            # Rolling SLI gauges + burn rates (fast window), flat
+            # for /metrics; GET /slo carries the full document.
+            out.update(self._slo.metrics())
+        if self._occ_ring is not None:
+            # Latest occupancy sample, flattened into gauges; the
+            # timeline itself exports via the Chrome counter track
+            # and the flight bundle's tail.
+            out["occupancy_samples_total"] = (
+                self._occ_ring.samples_total
+            )
+            last = self._occ_ring.last()
+            if last:
+                for k, v in last.items():
+                    out["occupancy_" + k] = v
+        op_ms = getattr(self._cache, "op_broadcast_ms", None)
+        if op_ms:
+            # Slice-cache per-op broadcast bill (rung 25): dict of
+            # op kind -> [frames, cumulative ms], rendered as two
+            # labelled counters in /metrics.
+            out["slice_op_ms"] = {k: list(v) for k, v in op_ms.items()}
+        # Scheduler observability: per-class queue depth and wait
+        # histograms, preemption/resume/shed counters, swap gauges.
+        out.update(self._sched.stats_locked())
+        if self._degraded_reason:
+            out["degraded_reason"] = self._degraded_reason
+        if self._spec:
+            # Realized acceleration PER GREEDY SLOT: mean tokens a
+            # greedy slot emits per verify pass it participates in
+            # (1.0 = speculation never paid; K+1 = every draft
+            # accepted) — normalized by slot-participations, not
+            # passes, so concurrency cannot inflate it.
+            out["spec_draft_len"] = self._spec
+            out["spec_passes"] = self._spec_passes
+            out["spec_emitted_per_pass"] = round(
+                self._spec_emitted / self._spec_slot_passes, 3
+            ) if self._spec_slot_passes else 0.0
+        if self._spec_window:
+            # Device-resident spec windows (SERVING.md rung 20):
+            # the knob, the dispatch count, and the per-window
+            # emitted-tokens histogram (in-window acceptance E —
+            # logical passes per dispatch for the Perfetto view).
+            out["spec_window"] = self._spec_window
+            out["spec_windows_total"] = self._spec_windows
+            out["spec_window_sampled"] = (
+                1 if self._spec_sampled_window else 0
+            )
+            out["spec_window_emitted_tokens"] = (
+                self._hist_spec_tokens.snapshot()
+            )
+        if self._spec_decision is not None:
+            # The boot-time economics decision (resolve_speculation)
+            # — present even after an auto fallback zeroed _spec, so
+            # an operator can see WHY speculation is off.
+            out["spec_decision"] = dict(self._spec_decision)
+        return out
+
+    # ---- SLO engine + flight bundle (SERVING.md rung 25) -----------------
+
+    def slo_doc(self) -> dict | None:
+        """The ``GET /slo`` document, or None when the engine is off
+        (the route 404s with the knob pointer). Lock-free: the engine
+        reads ring copies."""
+        if self._slo is None:
+            return None
+        return self._slo.doc()
+
+    def _config_doc_locked(self) -> dict:
+        """The serving-shape config the bundle fingerprints — enough
+        to tell 'same knobs, new failure' from 'different deployment'
+        across two bundles without shipping the whole payload TOML."""
+        return {
+            "slots": self._cache.slots,
+            "pages_total": self._pages_total,
+            "page_size": self._cache.page_size,
+            "window": self._window,
+            "overlap": self._overlap,
+            "speculative": self._spec,
+            "spec_window": self._spec_window,
+            "spec_sampled_window": int(self._spec_sampled_window),
+            "prefill_chunk": self._prefill_chunk,
+            "prefix_cache": int(self._prefix_enabled),
+            "checkpoint_every": self._checkpoint_every,
+            "page_low_watermark": self._page_low_wm,
+            "page_high_watermark": self._page_high_wm,
+            "kv_dtype": ("int8" if self._cache.kv_quantized
+                         else str(self._cfg.dtype)),
+            "slo": (dataclasses.asdict(self._slo.objectives)
+                    if self._slo is not None else None),
+        }
+
+    def flight_bundle(self) -> dict:
+        """The rung-25 post-mortem bundle: one versioned JSON document
+        carrying everything a human (or the chaos harness) needs to
+        explain a dead replica — metrics snapshot, SLO/burn state,
+        occupancy timeline tail, journal summary, page-accounting
+        books, config fingerprint, trace tail.
+
+        Everything under the lock is ONE acquisition, so the metrics
+        snapshot, the SLO state and the page books are mutually
+        consistent (the chaos invariant compares them). Works on a
+        poisoned pool: nothing here touches device state beyond the
+        same host-side books stats() already reads."""
+        with self._lock:
+            doc = {
+                "bundle_version": 1,
+                "reason": self._degraded_reason,
                 "degraded": 1 if self._degraded_reason else 0,
-                "in_flight": len(self._active),
-                "free_slots": len(self._free_slots),
-                "free_pages": self._cache.free_pages(),
-                "reserved_pages": self._reserved,
-                # Capacity semantics (SERVING.md rung 21): the page
-                # pool is the admission resource and the bucket is the
-                # device batch dim — the gauges an operator needs to
-                # see shed/preempt pressure coming.
-                "pages_total": self._pages_total,
-                "slots_total": self._cache.slots,
-                "bucket": self._cache.bucket,
-                "bucket_min": self._cache.min_bucket,
-                "page_low_watermark": self._page_low_wm,
-                "page_high_watermark": self._page_high_wm,
-                "window": self._window,
-                "kv_dtype": ("int8" if self._cache.kv_quantized
-                             else str(self._cfg.dtype)),
-                "prefix_entries": len(self._prefix_entry_nodes),
-                "prefix_hits": self._prefix_hits,
-                "prefix_lookups": self._prefix_lookups,
-                "prefix_tokens_saved": self._prefix_tokens_saved,
-                # Prefix-cache semantics (SERVING.md rung 24): COW
-                # divergence copies, HBM bytes the shared prefixes
-                # avoided re-prefilling, the host residency tier, and
-                # evictions by cause (one labelled counter in
-                # /metrics).
-                "prefix_bytes_saved": self._prefix_tokens_saved * (
-                    self._page_bytes_locked()
-                    // self._cache.page_size),
-                "prefix_cow_copies": self._prefix_cow_copies,
-                "prefix_host_entries": len(self._prefix_host_nodes),
-                "prefix_host_bytes": self._prefix_host_bytes,
-                "prefix_demotions": self._prefix_demotions,
-                "prefix_promotions": self._prefix_promotions,
-                "prefix_evictions": dict(self._prefix_evictions),
-                "journal_shadow_nodes": len(self._prefix_shadow),
-                "journal_shadow_bytes": self._journal.extra_bytes,
-                "overlap": 1 if self._overlap_on else 0,
-                "overlap_windows_total": self._overlap_windows,
-                "overlap_inflight_depth":
-                    1 if self._inflight is not None else 0,
-                # Histogram snapshots (dict-valued; status.py renders
-                # them as Prometheus histograms, scalar consumers
-                # should skip them).
-                "window_dispatch_harvest_ms": self._hist_rtt.snapshot(),
-                "window_host_ms": self._hist_host.snapshot(),
-                "window_inflight_depth": self._hist_depth.snapshot(),
-                # Per-request stage histograms (SERVING.md rung 18):
-                # TTFT and the queue-vs-decode split.
-                "ttft_ms": self._hist_ttft.snapshot(),
-                "queue_ms": self._hist_queue.snapshot(),
-                "decode_ms": self._hist_decode.snapshot(),
-                # Durability semantics (SERVING.md rung 22): journal
-                # occupancy, checkpoint throughput, and the restores
-                # revive() performed — the gauges that prove in-flight
-                # requests are actually covered.
-                "checkpoint_every": self._checkpoint_every,
-                "journal_entries": len(self._journal),
-                "journal_bytes": self._journal.nbytes,
-                "checkpoints_total": self._checkpoints_total,
-                "checkpoint_skipped_total": self._checkpoint_skipped,
-                "journal_restores_total": self._journal_restores,
-                # Device-resident endgame (SERVING.md rung 23):
-                # windowed-path collapses by cause (rendered as one
-                # labelled Prometheus counter) and stop-token finishes.
-                "spec_window_fallbacks": dict(
-                    self._spec_window_fallbacks
-                ),
-                "stop_finishes_total": self._stop_finishes,
+                "metrics": self._stats_locked(),
+                "slo": (self._slo.doc()
+                        if self._slo is not None else None),
+                "occupancy_tail": (self._occ_ring.tail()
+                                   if self._occ_ring is not None
+                                   else []),
+                "journal": {
+                    "entries": len(self._journal),
+                    "bytes": self._journal.nbytes,
+                    "extra_bytes": self._journal.extra_bytes,
+                    "budget_bytes": self._journal.max_bytes,
+                },
+                "config": self._config_doc_locked(),
             }
-            if self.tracer is not None:
-                out.update(self.tracer.stats())
-            # Scheduler observability: per-class queue depth and wait
-            # histograms, preemption/resume/shed counters, swap gauges.
-            out.update(self._sched.stats_locked())
-            if self._degraded_reason:
-                out["degraded_reason"] = self._degraded_reason
-            if self._spec:
-                # Realized acceleration PER GREEDY SLOT: mean tokens a
-                # greedy slot emits per verify pass it participates in
-                # (1.0 = speculation never paid; K+1 = every draft
-                # accepted) — normalized by slot-participations, not
-                # passes, so concurrency cannot inflate it.
-                out["spec_draft_len"] = self._spec
-                out["spec_passes"] = self._spec_passes
-                out["spec_emitted_per_pass"] = round(
-                    self._spec_emitted / self._spec_slot_passes, 3
-                ) if self._spec_slot_passes else 0.0
-            if self._spec_window:
-                # Device-resident spec windows (SERVING.md rung 20):
-                # the knob, the dispatch count, and the per-window
-                # emitted-tokens histogram (in-window acceptance E —
-                # logical passes per dispatch for the Perfetto view).
-                out["spec_window"] = self._spec_window
-                out["spec_windows_total"] = self._spec_windows
-                out["spec_window_sampled"] = (
-                    1 if self._spec_sampled_window else 0
-                )
-                out["spec_window_emitted_tokens"] = (
-                    self._hist_spec_tokens.snapshot()
-                )
-            if self._spec_decision is not None:
-                # The boot-time economics decision (resolve_speculation)
-                # — present even after an auto fallback zeroed _spec, so
-                # an operator can see WHY speculation is off.
-                out["spec_decision"] = dict(self._spec_decision)
-            return out
+            books = getattr(self._cache, "page_accounting", None)
+            if books is not None:
+                try:
+                    doc["page_accounting"] = books()
+                except Exception:
+                    # A torn-down cache must not take the bundle with
+                    # it — the post-mortem is most valuable exactly
+                    # when things are broken.
+                    doc["page_accounting"] = None
+        doc["config_fingerprint"] = hashlib.sha256(
+            json.dumps(doc["config"], sort_keys=True).encode("utf-8")
+        ).hexdigest()[:12]
+        # Trace tail outside the lock: the tracer ring is lock-free by
+        # contract and last_events() can retry its snapshot.
+        doc["trace_tail"] = (self.tracer.last_events()
+                             if self.tracer is not None else [])
+        return doc
+
+    def _occupancy_fields_locked(self) -> dict:
+        """One occupancy sample (lock held): pool pages/HBM from the
+        cache plus the serving layer's own residency gauges. All O(1)
+        attribute reads — safe at every quiescent boundary."""
+        fields = {
+            "slots_active": len(self._active),
+            "reserved_pages": self._reserved,
+            "prefix_entries": len(self._prefix_entry_nodes),
+            "prefix_host_bytes": self._prefix_host_bytes,
+            "journal_bytes": self._journal.nbytes,
+            "queue_depth": self._sched.depth_locked(),
+        }
+        occ = getattr(self._cache, "occupancy", None)
+        if occ is not None:
+            fields.update(occ())
+        return fields
+
+    def _observe_boundary_locked(self) -> None:
+        """Quiescent-boundary observability feed (rung 25, lock held):
+        one SLO-ring snapshot (throttled inside the engine) and one
+        occupancy sample. Touches no device state and emits nothing —
+        bit-identity with the knobs off is structural (None checks)."""
+        if self._slo is None and self._occ_ring is None:
+            return
+        now = time.perf_counter()
+        if self._slo is not None:
+            self._slo.observe(now, {
+                "ttft_ms": self._hist_ttft.snapshot(),
+                "itl_ms": self._hist_itl.snapshot(),
+                "queue_ms": self._hist_queue.snapshot(),
+                "tokens_total": self._tokens_done_total,
+                "done_total": self._done_total,
+                "shed_total": self._sched.shed,
+            })
+        if self._occ_ring is not None:
+            self._occ_ring.sample(
+                now, self._occupancy_fields_locked()
+            )
 
     # ---- decode loop -----------------------------------------------------
 
@@ -2609,6 +2823,14 @@ class PagedGenerationServer:
         t1 = time.perf_counter()
         if req.t_admit:
             self._hist_decode.observe((t1 - req.t_admit) * 1e3)
+        # Goodput + inter-token SLI inputs (rung 25): every normal
+        # finish funnels through here, so the counters are exact.
+        self._done_total += 1
+        self._tokens_done_total += len(req.generated)
+        if req.t_first and len(req.generated) > 1:
+            self._hist_itl.observe(
+                (t1 - req.t_first) * 1e3 / (len(req.generated) - 1)
+            )
         if req.trace:
             self.tracer.span(
                 "decode", "serve", req.t_admit or t1, t1, rid=req.rid,
@@ -3160,6 +3382,7 @@ class PagedGenerationServer:
                 self._maybe_preempt_locked()
                 self._maybe_step_bucket_locked()
                 self._maybe_checkpoint_locked()
+                self._observe_boundary_locked()
                 if not self._active:
                     return "ran"
                 if (self._spec > 0
@@ -3213,6 +3436,12 @@ class PagedGenerationServer:
                         produced = np.asarray(self._sampled_window(
                             tokens, window, mask, samplers
                         ))
+                    # Serial path: the host blocks for the whole
+                    # dispatch+force, so device time IS the call
+                    # (rung 25 attribution; no pipeline slack here).
+                    self._hist_device.observe(
+                        (time.perf_counter() - t0) * 1e3
+                    )
                     if self.tracer is not None:
                         # Fabric span (ungated): every window stamps,
                         # sampled request spans hang from them.
@@ -3251,6 +3480,11 @@ class PagedGenerationServer:
                     self._params, jnp.asarray(tokens), active=mask
                 )
                 next_tokens = self._next_tokens(logits)
+                # Per-step device time (serial path, rung 25): the
+                # pick inside _next_tokens is the forcing read.
+                self._hist_device.observe(
+                    (time.perf_counter() - t0) * 1e3
+                )
                 if self.tracer is not None:
                     self.tracer.span(
                         "step", "serve", t0,
@@ -3338,6 +3572,7 @@ class PagedGenerationServer:
                     self._maybe_preempt_locked()
                     self._maybe_step_bucket_locked()
                     self._maybe_checkpoint_locked()
+                    self._observe_boundary_locked()
                     if not self._active:
                         return "ran"
                     if (self._spec > 0
@@ -3571,8 +3806,13 @@ class PagedGenerationServer:
         token. Each row's stream truncates at its own dispatch-time
         cap (``adv``) — rows past their cap were frozen on device and
         their produced entries merely repeat the last live token."""
+        t_force = time.perf_counter()
         produced = np.asarray(self._cache.harvest_window(rec["handle"]))
         t_harvest = time.perf_counter()
+        # Device-time attribution (rung 25): the forced transfer is
+        # where the host actually waits on the device — the RTT minus
+        # this is pure host bookkeeping and pipeline slack.
+        self._hist_device.observe((t_harvest - t_force) * 1e3)
         self._hist_rtt.observe((t_harvest - rec["t0"]) * 1e3)
         if self.tracer is not None:
             # Dispatch -> harvest span with the pipeline depth the
@@ -3739,10 +3979,13 @@ class PagedGenerationServer:
         device-side overshoot (the last live pass may exceed the
         budget by up to K) never over-emits, exactly like the legacy
         path's room cap."""
+        t_force = time.perf_counter()
         emitted, counts, _pending = self._cache.harvest_spec_window(
             rec["handle"]
         )
         t_harvest = time.perf_counter()
+        # Device-time attribution (rung 25), as in _harvest_locked.
+        self._hist_device.observe((t_harvest - t_force) * 1e3)
         self._hist_rtt.observe((t_harvest - rec["t0"]) * 1e3)
         if self.tracer is not None:
             self.tracer.span(

@@ -244,6 +244,20 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
         # <state_dir>/traces/ (newest last; TraceCapture.list).
         return trace_capture.list()
 
+    def slo_doc() -> dict | None:
+        # GET /slo: the rolling SLI + burn-rate document. Read at
+        # request time — None (404) until the serve payload is live
+        # AND [payload] serving_slo is enabled.
+        fn = getattr(handle.serve_fn, "slo", None)
+        return fn() if fn is not None else None
+
+    def bundle_doc() -> dict | None:
+        # GET /debug/bundle: the flight-recorder bundle, assembled on
+        # demand under one server lock acquisition so its metrics,
+        # SLO state, and page books are mutually consistent.
+        fn = getattr(handle.serve_fn, "bundle", None)
+        return fn() if fn is not None else None
+
     def serve_degraded() -> str | None:
         # Lock-free by contract (workload.py attaches a plain attribute
         # read): /healthz is hit by liveness probes every few seconds
@@ -306,6 +320,8 @@ def start_runtime(cfg: RuntimeConfig) -> RuntimeHandle:
         health_detail=health_detail,
         trace_doc=trace_doc,
         profile_traces=profile_traces,
+        slo_doc=slo_doc,
+        bundle_doc=bundle_doc,
     )
     handle = RuntimeHandle(
         cfg=cfg, check=_booting(), writer=writer, server=server,

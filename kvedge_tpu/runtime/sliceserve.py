@@ -53,6 +53,7 @@ climbs on top of the reference's deployment story.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import numpy as np
 
@@ -275,6 +276,14 @@ class SlicePagedKVCache(PagedKVCache):
         self._pending_ops: list = []
         self.coalesced_flushes = 0
         self.coalesced_ops = 0
+        # Per-op broadcast attribution (SERVING.md rung 25): cumulative
+        # wall time each op KIND spent in the header+payload broadcast
+        # and collective execution, keyed by the op name ("sync",
+        # "windowp", ..., "multi" for coalesced frames). Plain dict of
+        # [count, total_ms] mutated only by the leader's op thread
+        # under the serving work lock; rendered in /metrics as the
+        # labelled kvedge_serve_device_ms_broadcast_total family.
+        self.op_broadcast_ms: dict[str, list] = {}
         # Leader-side watchdog over the op stream (header send,
         # broadcast, exec): a wedged collective surfaces as a typed
         # SliceFollowerLost instead of an eternal hang holding the
@@ -515,22 +524,30 @@ class SlicePagedKVCache(PagedKVCache):
         shared a tracer (``cache.tracer``, runtime/tracing.py). The
         span covers header send + payload broadcast + the collective's
         execution — the seam where a slow or lost follower shows up, so
-        a stalled slice is attributable to the op that stalled it. Off
-        (no tracer) this is exactly ``self._ops.run``."""
+        a stalled slice is attributable to the op that stalled it.
+        Tracer or not, the per-op-kind cumulative bill
+        (``op_broadcast_ms``, rung 25) always accrues: two
+        perf_counter stamps and a dict bump, the same always-on cost
+        contract as the serving layer's stage histograms."""
         tr = getattr(self, "tracer", None)
-        if tr is None:
-            return self._ops.run(key, op, budget_s=budget_s)
-        if self._ops.tracer is None:
+        if tr is not None and self._ops.tracer is None:
             # Lazy share (also re-shares after reform() swaps in a
             # fresh runner): a timeout's "op-timeout" instant lands in
             # the same timeline as the op spans it interrupts.
             self._ops.tracer = tr
-        t0 = tr.now()
+        t0 = time.perf_counter()
         try:
             return self._ops.run(key, op, budget_s=budget_s)
         finally:
-            tr.span(str(key[0]), "slice", t0,
-                    args={"op": "/".join(str(k) for k in key)})
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            cell = self.op_broadcast_ms.get(str(key[0]))
+            if cell is None:
+                cell = self.op_broadcast_ms[str(key[0])] = [0, 0.0]
+            cell[0] += 1
+            cell[1] += dt_ms
+            if tr is not None:
+                tr.span(str(key[0]), "slice", t0,
+                        args={"op": "/".join(str(k) for k in key)})
 
     def _sync(self) -> None:
         if self._stopped or self._ops.dead is not None:

@@ -278,6 +278,87 @@ _SERVE_METRIC_FIELDS = (
     ("trace_sample", "serve_trace_sample", "gauge",
      "per-request trace sampling rate in (0, 1] (paged backend, "
      "serving_trace)"),
+    # Completion counters (SERVING.md rung 25): normal finishes and
+    # the tokens they realized — the goodput numerator.
+    ("requests_done_total", "serve_requests_done_total", "counter",
+     "requests that finished normally (cancels and failures "
+     "excluded; paged backend)"),
+    ("tokens_done_total", "serve_tokens_done_total", "counter",
+     "generated tokens realized by normally-finished requests "
+     "(paged backend)"),
+    # SLO engine (runtime/slo.py, [payload] serving_slo): rolling
+    # fast-window SLIs and the fast/slow error-budget burn rates.
+    # Present only while the engine is on; 0.0 = window not yet
+    # filled (the series must exist for recording rules).
+    ("slo_ttft_p99_ms", "serve_slo_ttft_p99_ms", "gauge",
+     "rolling fast-window TTFT p99 in ms (serving_slo)"),
+    ("slo_itl_p99_ms", "serve_slo_itl_p99_ms", "gauge",
+     "rolling fast-window per-request mean inter-token gap p99 in ms "
+     "(serving_slo)"),
+    ("slo_queue_p99_ms", "serve_slo_queue_p99_ms", "gauge",
+     "rolling fast-window admission queue-wait p99 in ms "
+     "(serving_slo)"),
+    ("slo_goodput_tps", "serve_slo_goodput_tps", "gauge",
+     "rolling fast-window goodput in generated tokens/s from "
+     "normally-finished requests (serving_slo)"),
+    ("slo_shed_rate", "serve_slo_shed_rate", "gauge",
+     "rolling fast-window shed fraction: shed / (shed + done) "
+     "(serving_slo)"),
+    ("slo_burn_fast", "serve_slo_burn_fast", "gauge",
+     "fast-window error-budget burn rate: worst bad-event fraction "
+     "/ (1 - serving_slo_target); 1.0 = budget spent at exactly "
+     "sustainable pace"),
+    ("slo_burn_slow", "serve_slo_burn_slow", "gauge",
+     "slow-window error-budget burn rate (the multi-window alert's "
+     "is-it-real half)"),
+    ("slo_alert", "serve_slo_alert", "gauge",
+     "1 while BOTH burn windows exceed the alert thresholds "
+     "(14x fast / 6x slow — the page condition, and the burn-gated "
+     "shed input when serving_slo_shed is on)"),
+    ("slo_snapshots_total", "serve_slo_snapshots_total", "counter",
+     "boundary snapshots accepted into the SLO ring (serving_slo)"),
+    ("slo_resets_total", "serve_slo_resets_total", "counter",
+     "SLO ring rebases after a counter reset (pool replaced — plain "
+     "revive() preserves counters and does not reset)"),
+    # Occupancy timeline ring (runtime/slo.py OccupancyRing,
+    # [payload] serving_occupancy_ring): the LATEST quiescent-boundary
+    # sample, flattened; the full timeline exports as Chrome counter
+    # tracks in GET /trace and the flight bundle's tail.
+    ("occupancy_samples_total", "serve_occupancy_samples_total",
+     "counter",
+     "occupancy samples taken at quiescent boundaries "
+     "(serving_occupancy_ring)"),
+    ("occupancy_pages_total", "serve_occupancy_pages_total", "gauge",
+     "pool pages at the last occupancy sample"),
+    ("occupancy_pages_live", "serve_occupancy_pages_live", "gauge",
+     "referenced (live) pool pages at the last occupancy sample"),
+    ("occupancy_pages_free", "serve_occupancy_pages_free", "gauge",
+     "free-list pages at the last occupancy sample"),
+    ("occupancy_hbm_bytes_used", "serve_occupancy_hbm_bytes_used",
+     "gauge",
+     "HBM bytes held by live KV pages at the last occupancy sample "
+     "(live pages x per-page pool bytes incl. int8 scales)"),
+    ("occupancy_bucket", "serve_occupancy_bucket", "gauge",
+     "active compile bucket at the last occupancy sample"),
+    ("occupancy_slots_admitted", "serve_occupancy_slots_admitted",
+     "gauge",
+     "slots with admitted page tables at the last occupancy sample"),
+    ("occupancy_slots_active", "serve_occupancy_slots_active", "gauge",
+     "slots actively decoding at the last occupancy sample"),
+    ("occupancy_reserved_pages", "serve_occupancy_reserved_pages",
+     "gauge",
+     "worst-case reserved pages at the last occupancy sample"),
+    ("occupancy_prefix_entries", "serve_occupancy_prefix_entries",
+     "gauge",
+     "HBM-resident prefix-cache entries at the last occupancy sample"),
+    ("occupancy_prefix_host_bytes",
+     "serve_occupancy_prefix_host_bytes", "gauge",
+     "host-tier prefix bytes at the last occupancy sample"),
+    ("occupancy_journal_bytes", "serve_occupancy_journal_bytes",
+     "gauge",
+     "journal bytes at the last occupancy sample"),
+    ("occupancy_queue_depth", "serve_occupancy_queue_depth", "gauge",
+     "parked admission tickets at the last occupancy sample"),
 )
 
 # Latency histograms from the serving path (models/scheduler.py _Hist
@@ -329,6 +410,17 @@ _SERVE_HISTOGRAM_FIELDS = (
     ("decode_ms", "serve_decode_ms",
      "admission-to-completion time in ms (the prefill + decode leg "
      "of the latency split)"),
+    # Device-time attribution (SERVING.md rung 25): the device-side
+    # slice of the dispatch->harvest window, timed around the forcing
+    # read at each sync point. serve_window_host_ms is its host
+    # complement; together they split serve_window_dispatch_harvest_ms.
+    ("window_device_ms", "serve_device_ms_window",
+     "device-side time per window in ms (dispatch to the forcing "
+     "harvest read; the host bookkeeping half is "
+     "serve_window_host_ms)"),
+    ("itl_ms", "serve_itl_ms",
+     "per-request mean inter-token gap in ms (first token to finish "
+     "over generated tokens - 1; observed once per normal finish)"),
 )
 
 
@@ -434,6 +526,30 @@ def render_metrics(snapshot: dict) -> str:
         for cause in sorted(evictions):
             lines.append(
                 f'{name}{{cause="{cause}"}} {evictions[cause]}')
+    # Per-op broadcast attribution (rung 25): the slice transport's
+    # cumulative frame count and milliseconds by op kind ({op:
+    # [frames, ms]}). OP_MULTI frames show up under their own label,
+    # so coalescing wins read directly as fewer frames per step.
+    op_ms = serving.get("slice_op_ms")
+    if isinstance(op_ms, dict) and op_ms:
+        frames_name = "kvedge_serve_device_broadcast_frames_total"
+        ms_name = "kvedge_serve_device_ms_broadcast_total"
+        lines.append(
+            f"# HELP {frames_name} control-plane broadcast frames "
+            "sent to the slice pool, by op kind (multi = coalesced "
+            "OP_MULTI envelopes)")
+        lines.append(f"# TYPE {frames_name} counter")
+        for op in sorted(op_ms):
+            cell = op_ms[op]
+            lines.append(f'{frames_name}{{op="{op}"}} {cell[0]}')
+        lines.append(
+            f"# HELP {ms_name} cumulative milliseconds spent inside "
+            "slice broadcasts (send + per-shard run + gather), by op "
+            "kind")
+        lines.append(f"# TYPE {ms_name} counter")
+        for op in sorted(op_ms):
+            cell = op_ms[op]
+            lines.append(f'{ms_name}{{op="{op}"}} {cell[1]:.3f}')
     for key, suffix, help_text in _SERVE_HISTOGRAM_FIELDS:
         hist = serving.get(key)
         if isinstance(hist, dict):
@@ -462,7 +578,9 @@ class StatusServer:
                  generator: Callable[[dict], dict] | None = None,
                  health_detail: Callable[[], dict | None] | None = None,
                  trace_doc: Callable[[], dict | None] | None = None,
-                 profile_traces: Callable[[], list] | None = None):
+                 profile_traces: Callable[[], list] | None = None,
+                 slo_doc: Callable[[], dict | None] | None = None,
+                 bundle_doc: Callable[[], dict | None] | None = None):
         outer = self
         self._healthy = healthy or (
             lambda: bool(snapshot().get("ok", False))
@@ -478,6 +596,12 @@ class StatusServer:
         # GET /profile/traces: the on-disk profiler captures under
         # <state_dir>/traces/ (runtime/profiling.py TraceCapture.list).
         self._profile_traces = profile_traces
+        # GET /slo: the rolling SLI/burn-rate document (runtime/slo.py
+        # SloEngine.doc). GET /debug/bundle: the flight-recorder bundle
+        # assembled on demand (models/serving.py flight_bundle). Either
+        # returning None means its knob is off -> 404 with a pointer.
+        self._slo_doc = slo_doc
+        self._bundle_doc = bundle_doc
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet by default
@@ -526,6 +650,26 @@ class StatusServer:
                             "error": "tracing is off — enable [payload] "
                                      "serving_trace (on, or a sample "
                                      "rate in (0, 1])"
+                        })
+                    else:
+                        self._send(200, doc)
+                elif self.path == "/slo":
+                    doc = (outer._slo_doc()
+                           if outer._slo_doc is not None else None)
+                    if doc is None:
+                        self._send(404, {
+                            "error": "SLO engine is off — enable "
+                                     "[payload] serving_slo = true"
+                        })
+                    else:
+                        self._send(200, doc)
+                elif self.path == "/debug/bundle":
+                    doc = (outer._bundle_doc()
+                           if outer._bundle_doc is not None else None)
+                    if doc is None:
+                        self._send(404, {
+                            "error": "flight recorder is off — enable "
+                                     "[payload] serving_bundle = true"
                         })
                     else:
                         self._send(200, doc)

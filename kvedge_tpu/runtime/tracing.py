@@ -111,6 +111,14 @@ class Tracer:
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._appended = 0
         self.epoch = time.perf_counter()
+        # Counter-track source (SERVING.md rung 25): a callable
+        # ``epoch -> [event dict]`` returning fully-formed Chrome
+        # counter events (ph="C") to merge into export_chrome — the
+        # serving layer hangs its occupancy timeline ring here
+        # (runtime/slo.py OccupancyRing.chrome_counters) so Perfetto
+        # draws HBM/page/bucket occupancy under the span timeline.
+        # None = no counter tracks; export is unchanged.
+        self.counter_source = None
 
     # ---- construction from the config knob -------------------------------
 
@@ -243,6 +251,13 @@ class Tracer:
             if a:
                 ev["args"] = a
             events.append(ev)
+        if self.counter_source is not None:
+            # Occupancy counter tracks (ph="C", rung 25). Best-effort:
+            # a broken source must never take /trace down with it.
+            try:
+                events.extend(self.counter_source(self.epoch) or [])
+            except Exception:
+                pass
         meta = [
             {
                 "name": "thread_name",

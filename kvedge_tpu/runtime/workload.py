@@ -1391,6 +1391,20 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
             # cache carries its own pool from the SAME derivation.
             slots, pages, page_size, _ = _serving_pool_dims(cfg, tcfg)
             spec_draft = _spec_draft_len(cfg)
+            # SLO engine ([payload] serving_slo*, SERVING.md rung 25):
+            # objectives travel as one frozen value object; None keeps
+            # the engine (and its boundary feed) out of the process.
+            slo_objectives = None
+            if cfg.serving_slo:
+                from kvedge_tpu.runtime.slo import SloObjectives
+                slo_objectives = SloObjectives(
+                    target=cfg.serving_slo_target,
+                    ttft_ms=cfg.serving_slo_ttft_ms,
+                    itl_ms=cfg.serving_slo_itl_ms,
+                    queue_ms=cfg.serving_slo_queue_ms,
+                    fast_window_s=cfg.serving_slo_fast_s,
+                    slow_window_s=cfg.serving_slo_slow_s,
+                )
             paged_server = PagedGenerationServer(
                 params, tcfg, slots=slots, pages=pages,
                 page_size=page_size,
@@ -1449,6 +1463,12 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 # page-conservation audit at every quiescent boundary.
                 checkpoint_every=cfg.serving_checkpoint_every,
                 debug_pages=cfg.serving_debug_pages,
+                # Observability plane (SERVING.md rung 25): the SLO
+                # engine with its knob-gated burn-rate shed input, and
+                # the occupancy timeline ring.
+                slo=slo_objectives,
+                slo_shed=cfg.serving_slo_shed,
+                occupancy_ring=cfg.serving_occupancy_ring,
             )
             # Degraded-mode observability: when the pool poisons
             # (runtime/failures.py), persist a post-mortem failure
@@ -1477,6 +1497,21 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                         # led to the poison, not just the final error.
                         record["trace"] = tracer.last_events()
                     hb_mod.write_failure_record(state_dir, record)
+                    if cfg.serving_bundle:
+                        # Full post-mortem bundle (rung 25) next to
+                        # the failure record: the machine-complete
+                        # document — consistent metrics + SLO/burn +
+                        # page books + occupancy tail — a dead
+                        # replica explains itself with. Best-effort:
+                        # a bundle failure must never mask the
+                        # failure record above.
+                        try:
+                            hb_mod.write_flight_bundle(
+                                state_dir,
+                                paged_server.flight_bundle(),
+                            )
+                        except Exception:
+                            pass
 
                 paged_server.on_degraded = _record_failure
             # Spec-mode economics probe (VERDICT r4 #7): measure this
@@ -2048,6 +2083,19 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
         # attribute reads — same no-lock contract as `degraded`.
         if paged_server is not None:
             serve_fn.capacity = paged_server.capacity_probe
+        # SLO + flight-bundle handles for the HTTP layer (rung 25):
+        # boot.py's /slo and /debug/bundle closures call these at
+        # request time. None = the route 404s with its knob pointer.
+        serve_fn.slo = (
+            paged_server.slo_doc
+            if paged_server is not None and cfg.serving_slo
+            else None
+        )
+        serve_fn.bundle = (
+            paged_server.flight_bundle
+            if paged_server is not None and cfg.serving_bundle
+            else None
+        )
         # Recovery-machine probe for /healthz: while the supervisor is
         # recovering, boot.health_detail reports 503 NON-terminal with
         # a retry-after hint; terminal only after escalation.

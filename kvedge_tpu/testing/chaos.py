@@ -171,9 +171,17 @@ def run_chaos_campaign(params, tcfg, seed: int, *, rounds: int = 2,
     # every-page-free check — and prefix reuse is orthogonal to the
     # basic durability story. ``prefix_mix`` flips it on and switches
     # the settle check to the refcount-aware invariant.
+    #
+    # The observability stack (rung 25) runs ON in every campaign: the
+    # SLO engine snapshots and occupancy ring sample at the same
+    # boundaries faults land on, and the flight-recorder completeness
+    # invariant below asserts the bundle survives every poison/revive.
+    from kvedge_tpu.runtime.slo import SloObjectives
+
     server = PagedGenerationServer(
         params, tcfg, cache=cache, prefix_cache=prefix_mix,
-        debug_pages=True, **cfg_draw,
+        debug_pages=True, slo=SloObjectives(), occupancy_ring=64,
+        **cfg_draw,
     )
     stems = []
     if prefix_mix:
@@ -295,6 +303,8 @@ def run_chaos_campaign(params, tcfg, seed: int, *, rounds: int = 2,
                 trace.append(f"[round {round_i} outcome {i}] ok")
             _check_settled(server, cache, fail,
                            context=f"round {round_i}")
+            _check_bundle(server, cache, fail,
+                          context=f"round {round_i}")
             plan.close()
         return ChaosResult(
             seed=seed, config=cfg_draw, rounds=rounds, fired=fired,
@@ -383,3 +393,60 @@ def _check_settled(server, cache, fail, *, context: str) -> None:
         if cache.free_pages() != acct["pages_total"]:
             fail(f"{context}: {acct['pages_total'] - cache.free_pages()}"
                  f" pages still held after force-evicting the registry")
+
+
+# Every key a version-1 flight-recorder bundle must carry
+# (models/serving.py flight_bundle). Completeness is the invariant:
+# a post-mortem missing its books or its SLO state is worse than no
+# post-mortem, because it looks authoritative.
+_BUNDLE_V1_KEYS = frozenset((
+    "bundle_version", "reason", "degraded", "metrics", "slo",
+    "occupancy_tail", "journal", "config", "config_fingerprint",
+    "trace_tail", "page_accounting",
+))
+
+
+def _check_bundle(server, cache, fail, *, context: str) -> None:
+    """Rung-25 flight-recorder completeness after every round: the
+    bundle must be schema-complete, JSON-serialisable, and its
+    SLO/burn state and page books must agree with a fresh stats()
+    snapshot — the bundle claims to BE the server's final state, so
+    any drift between the two means the single-lock assembly broke."""
+    import json as _json
+
+    bundle = server.flight_bundle()
+    missing = _BUNDLE_V1_KEYS - set(bundle)
+    if missing:
+        fail(f"{context}: bundle incomplete — missing "
+             f"{sorted(missing)}")
+    if bundle["bundle_version"] != 1:
+        fail(f"{context}: unknown bundle_version "
+             f"{bundle['bundle_version']!r}")
+    try:
+        _json.dumps(bundle)
+    except (TypeError, ValueError) as e:
+        fail(f"{context}: bundle is not JSON-serialisable: {e}")
+    if not bundle["config_fingerprint"]:
+        fail(f"{context}: bundle config_fingerprint is empty")
+    if bundle["slo"] is None:
+        fail(f"{context}: bundle has no SLO state with the engine on")
+    # The campaign's server runs with an occupancy ring, and settle
+    # happens after at least one quiescent boundary — the timeline
+    # tail must not be empty.
+    if not bundle["occupancy_tail"]:
+        fail(f"{context}: bundle occupancy_tail is empty")
+    books = bundle["page_accounting"]
+    if books is None:
+        fail(f"{context}: bundle page books absent (cache exposes "
+             "page_accounting)")
+    if books != cache.page_accounting():
+        fail(f"{context}: bundle page books diverge from the live "
+             f"pool: {books} vs {cache.page_accounting()}")
+    # SLO/burn agreement with the server's own metrics snapshot: the
+    # pool is quiescent after settle, so the flat slo_* gauges stats()
+    # exports must be exactly what the bundle froze.
+    stats = server.stats()
+    for key in stats:
+        if key.startswith("slo_") and bundle["metrics"].get(key) != stats[key]:
+            fail(f"{context}: bundle {key}={bundle['metrics'].get(key)!r}"
+                 f" != live stats {stats[key]!r}")

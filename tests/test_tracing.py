@@ -140,9 +140,10 @@ def _check_chrome(doc: dict) -> list:
     assert isinstance(events, list)
     named_tracks = {}
     payload = []
+    counters = []
     for ev in events:
         assert isinstance(ev["name"], str) and ev["name"]
-        assert ev["ph"] in ("X", "i", "M")
+        assert ev["ph"] in ("X", "i", "M", "C")
         assert ev["pid"] == 1
         assert isinstance(ev["tid"], int)
         if ev["ph"] == "M":
@@ -151,6 +152,15 @@ def _check_chrome(doc: dict) -> list:
             continue
         assert isinstance(ev["cat"], str) and ev["cat"]
         assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        if ev["ph"] == "C":
+            # Counter track (rung-25 occupancy timeline): numeric args
+            # only; counters draw their own track, so no thread_name
+            # metadata requirement applies.
+            assert isinstance(ev["args"], dict) and ev["args"]
+            for v in ev["args"].values():
+                assert isinstance(v, (int, float))
+            counters.append(ev)
+            continue
         if ev["ph"] == "X":
             assert ev["dur"] >= 0
         else:
@@ -159,7 +169,7 @@ def _check_chrome(doc: dict) -> list:
     for ev in payload:  # every span rides a named track
         assert ev["tid"] in named_tracks
         assert named_tracks[ev["tid"]] == ev["cat"]
-    return payload
+    return payload + counters
 
 
 def test_export_chrome_is_valid_trace_event_json():

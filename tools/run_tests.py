@@ -64,6 +64,12 @@ Usage::
                                          # journal refcounts, shared-
                                          # prefix chaos); deterministic
                                          # subset tier-1, soaks slow
+    python tools/run_tests.py --slo      # only the SLO engine +
+                                         # flight-recorder tests (-m
+                                         # slo: burn-rate windows,
+                                         # device-time attribution,
+                                         # occupancy ring, bundle
+                                         # completeness); fast, tier-1
     python tools/run_tests.py --lint     # lock-discipline gate: runs
                                          # tools/locklint.py over the
                                          # package (fast-fails on any
@@ -238,6 +244,11 @@ def main(argv: list[str] | None = None) -> int:
                          "residency, journal refcounts, and — without "
                          "the tier-1 'not slow' filter — the shared-"
                          "prefix chaos soak)")
+    ap.add_argument("--slo", action="store_true",
+                    help="run only the SLO engine + flight-recorder "
+                         "tests (forwards -m slo: burn-rate windows, "
+                         "device-time attribution, occupancy ring, "
+                         "bundle completeness)")
     ap.add_argument("--lint", action="store_true",
                     help="run the lock-discipline gate: tools/locklint.py "
                          "over kvedge_tpu/, then the analyzer's own tests "
@@ -271,6 +282,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "endgame"]
     if args.prefix:
         args.pytest_args += ["-m", "prefix"]
+    if args.slo:
+        args.pytest_args += ["-m", "slo"]
     if args.lint:
         # The analyzer gate runs FIRST and fast-fails: a tree with
         # unsuppressed findings should not spend minutes in pytest
