@@ -351,17 +351,19 @@ def measure_paged_decode(cfg, slots: int, prompt_len: int, n_new: int,
 
     def run_hostloop(cache) -> float:
         """Per-step dispatch WITH the per-step host read the serving
-        loop performs (the r3-era sampled-slot path, kept as the
-        baseline the window is measured against). An async-pipelined
-        loop that never fetches tokens would look much faster here in
-        low-latency relay sessions — and would not be the loop the
-        server can run, because it needs every token on the host to
-        emit and to check budgets."""
+        loop performs (the sampled-era baseline the window is measured
+        against). Runs the loop the server actually runs for an
+        all-greedy per-step batch — ``cache.step_tokens``, the fused
+        step+argmax program serving._loop_once dispatches — so the
+        read is [slots] ints, not [slots, V] logits plus a second
+        argmax dispatch. Still one round trip and one forced read per
+        token: an async loop that never fetches would look much faster
+        here and would not be a loop the server can run, because it
+        needs every token on the host to emit and to check budgets."""
         tokens = _prefill_slots(cache, params, prompts)
         start = time.perf_counter()
         for _ in range(n_new):
-            logits = cache.step(params, tokens)
-            tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            tokens = cache.step_tokens(params, tokens)
             np.asarray(tokens)  # the serving loop emits these
         elapsed = time.perf_counter() - start
         for s in range(slots):
@@ -681,6 +683,17 @@ def measure_sched_overload(cfg, slots: int, prompt_len: int, n_new: int,
     the interactive burst by swapping batch tenants to host at the next
     window boundary instead of making it wait out their full budgets.
 
+    Two wait measurements per class, deliberately redundant (rung 26's
+    strict-vs-fifo diagnosis): ``*_wait_p{50,99}_ms`` come from the
+    server's fixed-bucket admission histograms — a quantile there is
+    the BUCKET UPPER EDGE, so past 10 s the edges quantize to 30/60/
+    120 s and adjacent runs can report 3x apart while the true waits
+    differ by percent. ``*_ttft_p{50,99}_ms`` are exact client-side
+    first-token latencies (submit call to first streamed token), no
+    bucketing, measured through the same streaming path a frontend
+    uses. Disagreement between the two columns is bucket-quantization
+    artifact, not scheduler behavior.
+
     Returns ``(fifo_metrics, strict_metrics)`` dicts."""
     import threading
 
@@ -704,17 +717,26 @@ def measure_sched_overload(cfg, slots: int, prompt_len: int, n_new: int,
         )
         lock = threading.Lock()
         tokens_done = [0]
+        ttft_ms: dict[str, list[float]] = {"interactive": [], "batch": []}
         errors: list[Exception] = []
 
         def client(ci: int, pclass: str, budget: int) -> None:
             try:
-                server.submit([int(t) for t in prompts[ci]], budget,
-                              timeout=600.0, priority=pclass)
+                t_submit = time.perf_counter()
+                stream = server.submit_stream(
+                    [int(t) for t in prompts[ci]], budget,
+                    timeout=600.0, priority=pclass)
+                first = None
+                for tok in stream:
+                    if first is None:
+                        first = time.perf_counter()
             except Exception as e:  # pragma: no cover - fail loudly
                 errors.append(e)
                 return
             with lock:
                 tokens_done[0] += budget
+                if first is not None:
+                    ttft_ms[pclass].append((first - t_submit) * 1e3)
 
         batch_threads = [
             threading.Thread(target=client,
@@ -749,12 +771,21 @@ def measure_sched_overload(cfg, slots: int, prompt_len: int, n_new: int,
             raise errors[0]
         wait_i = stats["sched_queue_wait_ms_interactive"]
         wait_b = stats["sched_queue_wait_ms_batch"]
+
+        def _exact(xs: list[float], q: float) -> float:
+            return float(np.percentile(np.asarray(xs), 100 * q)) if xs \
+                else 0.0
+
         return {
             "goodput_tokens_per_sec": tokens_done[0] / elapsed,
             "interactive_wait_p50_ms": _hist_quantile(wait_i, 0.50),
             "interactive_wait_p99_ms": _hist_quantile(wait_i, 0.99),
             "batch_wait_p50_ms": _hist_quantile(wait_b, 0.50),
             "batch_wait_p99_ms": _hist_quantile(wait_b, 0.99),
+            "interactive_ttft_p50_ms": _exact(ttft_ms["interactive"], .50),
+            "interactive_ttft_p99_ms": _exact(ttft_ms["interactive"], .99),
+            "batch_ttft_p50_ms": _exact(ttft_ms["batch"], 0.50),
+            "batch_ttft_p99_ms": _exact(ttft_ms["batch"], 0.99),
             "preemptions": int(stats["sched_preemptions_total"]),
         }
 

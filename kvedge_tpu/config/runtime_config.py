@@ -116,6 +116,16 @@ def _parse_speculative(value):
     return int(value)
 
 
+def _parse_window(value):
+    """``serving_window``: an int window cap or the string "auto"
+    (the online controller, SERVING.md rung 26, picks the window per
+    boundary from measured R/t). Type errors surface in validate()
+    with the full accepted-values message."""
+    if isinstance(value, str):
+        return value  # validate() accepts only "auto"
+    return int(value)
+
+
 def _parse_trace(value):
     """``serving_trace``: "off"/"on" or a per-request sample rate in
     (0, 1]. Type errors surface in validate() with the full
@@ -354,8 +364,21 @@ class RuntimeConfig:
     # throughput from the relay RTT). Compiled programs stay the powers
     # of two {2..serving_window}. Tradeoff: a new request joins at the
     # next window boundary, so admission latency grows with the window
-    # (SERVING.md's performance model). 1 = per-step dispatch.
-    serving_window: int = 64
+    # (SERVING.md's performance model). 1 = per-step dispatch. "auto"
+    # hands the choice to the online controller (SERVING.md rung 26):
+    # every harvested window feeds EWMAs of the measured host
+    # turnaround R and per-step device time t, and the next window is
+    # the smallest power of two with W*t >= R — the saturation point
+    # of the rung-16 law, re-picked at every boundary inside
+    # [serving_window_min, serving_window_max].
+    serving_window: int | str = 64
+    # Controller bounds for serving_window="auto" (ignored for a
+    # static window): the smallest/largest window the controller may
+    # pick. Floored to powers of two. The floor guards boundary
+    # staleness (cancels and newcomers wait up to a window); the cap
+    # bounds the compiled-program set and admission latency.
+    serving_window_min: int = 1
+    serving_window_max: int = 256
     # Overlapped window dispatch for the paged backend: "auto"/"on"
     # run the double-buffered decode loop (window N+1 is enqueued on a
     # device-resident carry before window N is harvested, so host
@@ -662,8 +685,16 @@ class RuntimeConfig:
                 serving_prefix_persist=payload_doc.get(
                     "serving_prefix_persist", cls.serving_prefix_persist
                 ),
-                serving_window=int(
+                serving_window=_parse_window(
                     payload_doc.get("serving_window", cls.serving_window)
+                ),
+                serving_window_min=int(
+                    payload_doc.get("serving_window_min",
+                                    cls.serving_window_min)
+                ),
+                serving_window_max=int(
+                    payload_doc.get("serving_window_max",
+                                    cls.serving_window_max)
                 ),
                 serving_overlap=str(
                     payload_doc.get("serving_overlap",
@@ -904,10 +935,27 @@ class RuntimeConfig:
                 "[payload] serving_prefix_host_mb must be >= 0 "
                 "(0 disables the host residency tier)"
             )
-        if not 1 <= self.serving_window <= 1024:
+        if self.serving_window != "auto" and not (
+            isinstance(self.serving_window, int)
+            and 1 <= self.serving_window <= 1024
+        ):
             raise RuntimeConfigError(
                 "[payload] serving_window must be in [1, 1024] "
-                "(1 = per-step dispatch)"
+                "(1 = per-step dispatch) or 'auto' (online "
+                "controller, SERVING.md rung 26)"
+            )
+        if not 1 <= self.serving_window_min <= 1024:
+            raise RuntimeConfigError(
+                "[payload] serving_window_min must be in [1, 1024]"
+            )
+        if not 1 <= self.serving_window_max <= 1024:
+            raise RuntimeConfigError(
+                "[payload] serving_window_max must be in [1, 1024]"
+            )
+        if self.serving_window_min > self.serving_window_max:
+            raise RuntimeConfigError(
+                "[payload] serving_window_min must be <= "
+                "serving_window_max (controller bounds)"
             )
         if self.serving_overlap not in ("auto", "on", "off"):
             raise RuntimeConfigError(
@@ -1119,7 +1167,10 @@ class RuntimeConfig:
             f"serving_prefix_host_mb = {self.serving_prefix_host_mb}\n"
             "serving_prefix_persist = "
             f"{'true' if self.serving_prefix_persist else 'false'}\n"
-            f"serving_window = {self.serving_window}\n"
+            "serving_window = "
+            f"{s(self.serving_window) if isinstance(self.serving_window, str) else self.serving_window}\n"
+            f"serving_window_min = {self.serving_window_min}\n"
+            f"serving_window_max = {self.serving_window_max}\n"
             f"serving_overlap = {s(self.serving_overlap)}\n"
             "serving_speculative = "
             f"{s(self.serving_speculative) if isinstance(self.serving_speculative, str) else self.serving_speculative}\n"

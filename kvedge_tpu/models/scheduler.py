@@ -75,9 +75,13 @@ import time
 DEFAULT_CLASSES = ("interactive", "batch")
 
 # Queue-wait histogram buckets (milliseconds). Sub-ms admissions land
-# in the first bucket; the top edge is the default submit timeout.
+# in the first bucket; 120 s was the old cap (the default submit
+# timeout) — the log-spaced tail past it keeps overload p99s
+# measurable instead of clamped. Existing edges are unchanged so
+# cumulative bucket deltas stay comparable across snapshots.
 _WAIT_EDGES_MS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0,
-                  5000.0, 10000.0, 30000.0, 60000.0, 120000.0)
+                  5000.0, 10000.0, 30000.0, 60000.0, 120000.0,
+                  240000.0, 480000.0, 960000.0)
 
 # EWMA smoothing for the measured per-class queue wait (the shed
 # watermark and the retry_after hint): ~5 admissions of memory.

@@ -258,7 +258,8 @@ class PagedGenerationServer:
                  prefill_chunk: int = 0, prefix_cache: bool = True,
                  speculative: int = 0, spec_window: int = 0,
                  spec_sampled_window: bool = True,
-                 window: int = 64,
+                 window: int | str = 64,
+                 window_min: int = 1, window_max: int = 256,
                  kv_dtype: str = "", cache=None,
                  retry_after_s: float | None = None,
                  overlap: str = "auto", sched_policy: str = "strict",
@@ -297,6 +298,23 @@ class PagedGenerationServer:
         # {2..window} (see _window_steps); the tradeoff is admission
         # latency — a submitter joins at the next window boundary, so
         # worst-case wait grows with the window (SERVING.md).
+        # "auto" hands the choice to the online controller (SERVING.md
+        # rung 26): _window starts at the bounds cap and is re-picked
+        # at every harvested window from EWMAs of the measured host
+        # turnaround R and per-step device time t — the smallest power
+        # of two with W*t >= R, the saturation point of the rung-16
+        # law. The controller is plain data owned by this server and
+        # mutated only under the work lock; revive() and slice
+        # reformation never recreate it, so its learned state rides
+        # through recovery (tests/test_autotune.py).
+        self._autotune = None
+        if window == "auto":
+            from kvedge_tpu.runtime.autotune import WindowController
+            self._autotune = WindowController(lo=window_min,
+                                              hi=window_max)
+            window = self._autotune.window()
+        elif isinstance(window, str):
+            raise ValueError("window must be an int >= 1 or 'auto'")
         if window < 1:
             raise ValueError("window must be >= 1")
         self._window = window
@@ -333,9 +351,14 @@ class PagedGenerationServer:
         # perf_counter stamps, independent of the tracer): time to
         # first token (submit -> prefill logits picked), the
         # queue-vs-decode split (submit -> admit, admit -> done).
+        # The log-spaced tail past 30 s keeps overload-regime p99s
+        # measurable (openloop wait p99s used to clamp at the 30 000
+        # cap); the pre-existing edges are unchanged so cumulative
+        # bucket deltas stay comparable across bench snapshots.
         _stage_edges = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
                         200.0, 500.0, 1000.0, 2000.0, 5000.0,
-                        10000.0, 30000.0)
+                        10000.0, 30000.0, 60000.0, 120000.0,
+                        240000.0, 480000.0, 960000.0)
         self._hist_ttft = _Hist(_stage_edges)
         self._hist_queue = _Hist(_stage_edges)
         self._hist_decode = _Hist(_stage_edges)
@@ -390,6 +413,11 @@ class PagedGenerationServer:
                 "spec_window needs speculative mode (speculative > 0)"
             )
         self._spec_window = int(spec_window)
+        # Operator ceiling for the controller's spec-depth channel
+        # (rung 26): with serving_window=auto the effective
+        # _spec_window floats in [1, cap] at true boundaries; a static
+        # window pins it to the configured value forever.
+        self._spec_window_cap = int(spec_window)
         self._spec_windows = 0
         # On-device sampled verify ([payload] serving_spec_sampled_window,
         # SERVING.md rung 23): with the knob ON (default), a mixed
@@ -646,6 +674,11 @@ class PagedGenerationServer:
         self._ckpt_clock = 0
         self._checkpoints_total = 0
         self._checkpoint_skipped = 0
+        # Delta-skipped checkpoints (rung 26): live requests whose
+        # standing journal entry already matches (gen_len, next_token)
+        # — re-serializing would be byte-identical, so the boundary
+        # skips their device gather entirely.
+        self._checkpoints_unchanged = 0
         self._journal_restores = 0
         # Page-conservation audit ([payload] serving_debug_pages): the
         # chaos soak's invariant 1, checked at every quiescent boundary
@@ -1267,18 +1300,72 @@ class PagedGenerationServer:
         if not self._active:
             return
         t0 = time.perf_counter()
+        # Host-path elimination (rung 26): the old loop issued one
+        # device gather + one forced transfer PER live request, every
+        # checkpoint tick, even when nothing had changed. Two fixes:
+        #
+        # * Delta-skip — a request whose (gen_len, next_token) match
+        #   its standing entry would re-serialize byte-identical state
+        #   (decode only appends; KV below saved_len never mutates, and
+        #   spec slack past saved_len is outside the restore contract),
+        #   so it keeps the old entry at zero device work. A quiescent
+        #   boundary now costs O(changes), not O(live).
+        # * Coalesced gather — every page the boundary DOES need
+        #   (own suffixes + any new prefix shadows, deduped per node)
+        #   rides ONE ``swapout_pages`` call: one device program, one
+        #   forced transfer, sliced per entry on host. The slices are
+        #   compacted copies so the journal's byte accounting stays
+        #   honest (a view would pin the whole batch buffer).
+        jobs = []       # (req, saved_len, n_pages, own_span, sh_spans)
+        all_ids = []
+        new_shadow_spans: dict = {}   # node -> (start, sh_n)
         for slot, req in self._active.items():
             if req.cancelled:
                 continue
             saved_len = len(req.prompt) + len(req.generated)
+            prev = self._journal.get(req)
+            if (prev is not None
+                    and prev.gen_len == len(req.generated)
+                    and prev.next_token == req.next_token):
+                self._checkpoints_unchanged += 1
+                continue
             n_pages = -(-saved_len // self._cache.page_size)
             ids = self._cache.slot_pages(slot)[:n_pages]
             sh_n = len(req.shared_pages)
-            if req.prefix_node is not None and sh_n:
+            shared = req.prefix_node is not None and sh_n
+            own_ids = ids[sh_n:] if shared else ids
+            own_span = (len(all_ids), len(own_ids))
+            all_ids.extend(own_ids)
+            node = None
+            if shared:
+                node = req.prefix_node
+                if (node not in self._prefix_shadow
+                        and node not in new_shadow_spans):
+                    new_shadow_spans[node] = (len(all_ids), sh_n)
+                    all_ids.extend(ids[:sh_n])
+            jobs.append((req, saved_len, sh_n if shared else 0, node,
+                         own_span))
+        if not jobs:
+            return
+        batch = (self._cache.swapout_pages(all_ids)
+                 if all_ids else None)
+
+        def _slice(span):
+            # Gathered slabs are [L, n_pages, ...] (_gather_pages_impl)
+            # — the page dimension is axis 1, layers axis 0.
+            start, n = span
+            return tuple(np.ascontiguousarray(a[:, start:start + n])
+                         for a in batch)
+
+        new_shadows = {node: _slice(span)
+                       for node, span in new_shadow_spans.items()}
+        for req, saved_len, sh_n, node, own_span in jobs:
+            own = _slice(own_span)
+            if node is not None:
                 ok = self._checkpoint_shared_locked(
-                    req, ids, saved_len, n_pages)
+                    req, saved_len, sh_n, own,
+                    new_shadows.get(node))
             else:
-                arrays = self._cache.swapout_pages(ids)
                 entry = JournalEntry(
                     req=req, pclass=req.pclass,
                     ticket_no=req.ticket_no,
@@ -1287,8 +1374,8 @@ class PagedGenerationServer:
                     saved_len=saved_len, gen_len=len(req.generated),
                     next_token=req.next_token,
                     emitted=len(req.generated),
-                    arrays=arrays,
-                    nbytes=sum(a.nbytes for a in arrays),
+                    arrays=own,
+                    nbytes=sum(a.nbytes for a in own),
                 )
                 ok = self._journal.put(req, entry)
             if ok:
@@ -1307,9 +1394,10 @@ class PagedGenerationServer:
                       "bytes": self._journal.nbytes},
             )
 
-    def _checkpoint_shared_locked(self, req: _Request, ids,
-                                  saved_len: int,
-                                  n_pages: int) -> bool:
+    def _checkpoint_shared_locked(self, req: _Request,
+                                  saved_len: int, sh_n: int,
+                                  own: tuple,
+                                  sh_arrays: tuple | None) -> bool:
         """Checkpoint a request whose table starts on cached-prefix
         pages (lock held): the entry carries only the request's OWN
         page bytes plus a REFERENCE (trie node id + page/token depth)
@@ -1318,14 +1406,20 @@ class PagedGenerationServer:
         requests on one system prompt bill the journal budget 1 shadow
         + N suffixes, not N full copies (rung 24c). Refs bump BEFORE
         ``put`` so the on_drop of a replaced older entry (which fires
-        inside ``put``) nets correctly when both cite the same node."""
+        inside ``put``) nets correctly when both cite the same node.
+        ``own``/``sh_arrays`` arrive pre-gathered from the boundary's
+        single coalesced ``swapout_pages`` batch; ``sh_arrays`` is
+        only consulted when the node's shadow does not exist yet."""
         node = req.prefix_node
-        sh_n = len(req.shared_pages)
-        own = self._cache.swapout_pages(ids[sh_n:])
         shadow = self._prefix_shadow.get(node)
         extra = 0
         if shadow is None:
-            sh_arrays = self._cache.swapout_pages(ids[:sh_n])
+            if sh_arrays is None:
+                # Should be unreachable — the batching loop gathers
+                # shadow bytes for every node it cannot find — but a
+                # refused-then-retried node races only against itself,
+                # so refuse rather than journal a dangling reference.
+                return False
             extra = sum(a.nbytes for a in sh_arrays)
             shadow = {"arrays": sh_arrays, "nbytes": extra,
                       "refs": 0, "npages": sh_n}
@@ -2510,10 +2604,28 @@ class PagedGenerationServer:
         return len(restored) + requeued
 
     def stats(self) -> dict:
+        # /metrics aggregation mostly off the work lock (rung 26
+        # host-path budget): the lock covers only the raw counter and
+        # histogram copies that mutate under it; the tracer/SLO/
+        # occupancy/slice merges are documented ring-copy reads and
+        # happen after release, so a scrape no longer taxes a decode
+        # boundary with their assembly. The Prometheus text rendering
+        # itself (runtime/status.py) was always outside.
         with self._lock:
-            return self._stats_locked()
+            out = self._stats_core_locked()
+        self._stats_merge_unlocked(out)
+        return out
 
     def _stats_locked(self) -> dict:
+        # The flight bundle's variant: ONE acquisition covers the
+        # whole document so metrics/SLO/books stay mutually
+        # consistent (the chaos invariant). The merge helpers are
+        # lock-free reads, safe to run with the lock held too.
+        out = self._stats_core_locked()
+        self._stats_merge_unlocked(out)
+        return out
+
+    def _stats_core_locked(self) -> dict:
         out = {
             "degraded": 1 if self._degraded_reason else 0,
             "in_flight": len(self._active),
@@ -2586,6 +2698,7 @@ class PagedGenerationServer:
             "journal_bytes": self._journal.nbytes,
             "checkpoints_total": self._checkpoints_total,
             "checkpoint_skipped_total": self._checkpoint_skipped,
+            "checkpoint_unchanged_total": self._checkpoints_unchanged,
             "journal_restores_total": self._journal_restores,
             # Device-resident endgame (SERVING.md rung 23):
             # windowed-path collapses by cause (rendered as one
@@ -2595,29 +2708,17 @@ class PagedGenerationServer:
             ),
             "stop_finishes_total": self._stop_finishes,
         }
-        if self.tracer is not None:
-            out.update(self.tracer.stats())
-        if self._slo is not None:
-            # Rolling SLI gauges + burn rates (fast window), flat
-            # for /metrics; GET /slo carries the full document.
-            out.update(self._slo.metrics())
-        if self._occ_ring is not None:
-            # Latest occupancy sample, flattened into gauges; the
-            # timeline itself exports via the Chrome counter track
-            # and the flight bundle's tail.
-            out["occupancy_samples_total"] = (
-                self._occ_ring.samples_total
-            )
-            last = self._occ_ring.last()
-            if last:
-                for k, v in last.items():
-                    out["occupancy_" + k] = v
-        op_ms = getattr(self._cache, "op_broadcast_ms", None)
-        if op_ms:
-            # Slice-cache per-op broadcast bill (rung 25): dict of
-            # op kind -> [frames, cumulative ms], rendered as two
-            # labelled counters in /metrics.
-            out["slice_op_ms"] = {k: list(v) for k, v in op_ms.items()}
+        if self._autotune is not None:
+            # Online window controller (SERVING.md rung 26): the
+            # current pick and its EWMA inputs — R (host turnaround
+            # per window) and t (per-step device time). R/t gauges
+            # make the law auditable from a scrape: the pick should
+            # be the smallest pow2 with window*t >= R.
+            snap = self._autotune.snapshot()
+            out["autotune_window"] = snap["window"]
+            out["autotune_r_ms"] = round(snap["r_ms"], 3)
+            out["autotune_t_ms"] = round(snap["t_ms"], 4)
+            out["autotune_updates"] = snap["updates"]
         # Scheduler observability: per-class queue depth and wait
         # histograms, preemption/resume/shed counters, swap gauges.
         out.update(self._sched.stats_locked())
@@ -2653,6 +2754,37 @@ class PagedGenerationServer:
             # an operator can see WHY speculation is off.
             out["spec_decision"] = dict(self._spec_decision)
         return out
+
+    def _stats_merge_unlocked(self, out: dict) -> None:
+        """Merge the lock-free observability planes into a stats
+        snapshot: the tracer, the SLO engine and the occupancy ring
+        all read ring copies, and the slice cache's broadcast bill is
+        a plain dict the runner thread owns. Callable with or without
+        the work lock (stats() releases it first; flight_bundle()
+        keeps its single-acquisition consistency contract)."""
+        if self.tracer is not None:
+            out.update(self.tracer.stats())
+        if self._slo is not None:
+            # Rolling SLI gauges + burn rates (fast window), flat
+            # for /metrics; GET /slo carries the full document.
+            out.update(self._slo.metrics())
+        if self._occ_ring is not None:
+            # Latest occupancy sample, flattened into gauges; the
+            # timeline itself exports via the Chrome counter track
+            # and the flight bundle's tail.
+            out["occupancy_samples_total"] = (
+                self._occ_ring.samples_total
+            )
+            last = self._occ_ring.last()
+            if last:
+                for k, v in last.items():
+                    out["occupancy_" + k] = v
+        op_ms = getattr(self._cache, "op_broadcast_ms", None)
+        if op_ms:
+            # Slice-cache per-op broadcast bill (rung 25): dict of
+            # op kind -> [frames, cumulative ms], rendered as two
+            # labelled counters in /metrics.
+            out["slice_op_ms"] = {k: list(v) for k, v in op_ms.items()}
 
     # ---- SLO engine + flight bundle (SERVING.md rung 25) -----------------
 
@@ -2885,6 +3017,24 @@ class PagedGenerationServer:
         req.generated.append(token)
         if req.stream is not None and idx >= req.stream_resume_at:
             req.stream.put(token)
+
+    @staticmethod
+    def _emit_many(req: _Request, tokens: list) -> None:
+        """Bulk :meth:`_emit`: one ``extend`` for the token log and
+        the same exactly-once replay watermark for the stream. The
+        harvest hot path hands whole per-row windows here (plain
+        Python ints from ``ndarray.tolist()``) instead of looping
+        ``_emit`` per token — the per-token Python frame was a
+        measurable slice of the boundary budget at window 64."""
+        if not tokens:
+            return
+        idx = len(req.generated)
+        req.generated.extend(tokens)
+        if req.stream is not None:
+            skip = req.stream_resume_at - idx
+            put = req.stream.put
+            for t in (tokens[skip:] if skip > 0 else tokens):
+                put(t)
 
     @staticmethod
     def _draft(req: _Request, k: int) -> list[int]:
@@ -3476,10 +3626,25 @@ class PagedGenerationServer:
                             )
                     return "ran"
                 t0 = time.perf_counter()
-                logits = self._cache.step(
-                    self._params, jnp.asarray(tokens), active=mask
-                )
-                next_tokens = self._next_tokens(logits)
+                if all(req.sampling is None
+                       for req in self._active.values()):
+                    # All-greedy per-step batch: the fused step+argmax
+                    # program (kvcache.step_tokens) — one dispatch and
+                    # a [B]-int read instead of a dispatch, a second
+                    # argmax dispatch, and a [B, V] logits transfer.
+                    # Token-identical: same argmax on the same logits.
+                    picked = np.asarray(self._cache.step_tokens(
+                        self._params, jnp.asarray(tokens), active=mask
+                    ))
+                    next_tokens = {
+                        slot: int(picked[slot])
+                        for slot in self._active
+                    }
+                else:
+                    logits = self._cache.step(
+                        self._params, jnp.asarray(tokens), active=mask
+                    )
+                    next_tokens = self._next_tokens(logits)
                 # Per-step device time (serial path, rung 25): the
                 # pick inside _next_tokens is the forcing read.
                 self._hist_device.observe(
@@ -3829,6 +3994,7 @@ class PagedGenerationServer:
         for _, req, adv in rec["parts"]:
             req.inflight -= adv
         w = rec["window"]
+        stop_row = produced[w + 1]
         for slot, req, adv in rec["parts"]:
             if self._active.get(slot) is not req or req.stopped:
                 # Released while in flight (hard-close/cancel races
@@ -3842,7 +4008,7 @@ class PagedGenerationServer:
             # 1 budget-frozen / 2 stop) and the 1-based step of the
             # first stop hit — ONE transfer carries tokens and
             # bookkeeping both, and the host never compares per-token.
-            stop_at = int(produced[w + 1, slot])
+            stop_at = int(stop_row[slot])
             if 0 < stop_at and not req.cancelled:
                 # Emit the pending token plus everything up to AND
                 # INCLUDING the stop token, then finish; steps past
@@ -3850,17 +4016,18 @@ class PagedGenerationServer:
                 # are discarded (the slot releases, so the device-side
                 # over-advance is moot).
                 room = req.n_new - len(req.generated)
-                seq = [req.next_token] + [
-                    int(produced[i, slot]) for i in range(stop_at)
-                ]
-                for t in seq[:room]:
-                    self._emit(req, t)
+                seq = [req.next_token]
+                seq += produced[:stop_at, slot].tolist()
+                self._emit_many(req, seq[:room])
                 self._finish_stopped_locked(slot, req)
                 continue
-            self._emit(req, req.next_token)
-            for i in range(adv - 1):
-                self._emit(req, int(produced[i, slot]))
-            req.next_token = int(produced[adv - 1, slot])
+            # Bulk emission: one C-level column->list conversion per
+            # LIVE row (rows the window advanced — O(changes), idle
+            # bucket slots never touched), one extend, no per-token
+            # Python frames.
+            toks = produced[:adv, slot].tolist()
+            self._emit_many(req, [req.next_token] + toks[:-1])
+            req.next_token = toks[-1]
             if (len(req.generated) + 1 >= req.n_new
                     and not req.cancelled):
                 # Inline finish: with the pipeline saturated the loop
@@ -3871,7 +4038,21 @@ class PagedGenerationServer:
                 self._emit(req, req.next_token)
                 self._finish_request_locked(slot, req)
         self._overlap_windows += 1
-        self._hist_host.observe((time.perf_counter() - t_host) * 1e3)
+        host_ms = (time.perf_counter() - t_host) * 1e3
+        self._hist_host.observe(host_ms)
+        if self._autotune is not None:
+            # Close the rung-16 loop (rung 26): feed the controller
+            # this window's measured split and adopt its pick for the
+            # NEXT dispatch. The carry redispatch takes the window as
+            # a plain scan length, so mid-pipeline changes are safe —
+            # the device carry is one token row, shape-independent of
+            # the window.
+            self._autotune.observe(
+                rtt_ms=(t_harvest - rec["t0"]) * 1e3,
+                device_ms=(t_harvest - t_force) * 1e3,
+                host_ms=host_ms, window=w,
+            )
+            self._window = self._autotune.window()
 
     def _dispatch_spec_window_locked(self, first: bool) -> dict | None:
         """Enqueue one device-resident spec window — ``_spec_window``
@@ -4009,8 +4190,9 @@ class PagedGenerationServer:
                 continue
             before = len(req.generated)
             stopped = False
+            counts_col = counts[:, slot].tolist()
             for p in range(rec["window"]):
-                c = int(counts[p, slot])
+                c = counts_col[p]
                 if c == 0:
                     # Frozen pass: the row's budget ran out on device
                     # (rem <= 0) — no tokens, no pending advance.
@@ -4020,20 +4202,20 @@ class PagedGenerationServer:
                 # (c == 1): seq is just the pending token and the
                 # device-sampled token becomes the next pending —
                 # the legacy _spec_pass semantics, scanned.
-                seq = [req.next_token] + [
-                    int(t) for t in emitted[p, slot, :c - 1]
-                ]
-                emit_n = 0
-                for t in seq[:room]:
-                    self._emit(req, t)
-                    emit_n += 1
-                    if t == req.stop_token:
-                        # Host-side stop truncation (the harvest
-                        # touches every token anyway): later passes
-                        # decoded garbage and are discarded.
-                        stopped = True
-                        break
-                req.next_token = int(emitted[p, slot, c - 1])
+                row = emitted[p, slot, :c].tolist()
+                seq = ([req.next_token] + row[:-1])[:room]
+                try:
+                    # Host-side stop truncation, now a C-level list
+                    # search instead of a per-token compare loop:
+                    # later passes decoded garbage and are discarded.
+                    stop_i = seq.index(req.stop_token)
+                    seq = seq[:stop_i + 1]
+                    stopped = True
+                except ValueError:
+                    pass
+                self._emit_many(req, seq)
+                emit_n = len(seq)
+                req.next_token = row[-1]
                 if req.sampling is None:
                     # Greedy acceleration stats only — sampled rows
                     # ride at one token per pass by construction and
@@ -4060,7 +4242,27 @@ class PagedGenerationServer:
                 self._note_finish_candidate_locked(slot, req)
         self._spec_windows += 1
         self._overlap_windows += 1
-        self._hist_host.observe((time.perf_counter() - t_host) * 1e3)
+        host_ms = (time.perf_counter() - t_host) * 1e3
+        self._hist_host.observe(host_ms)
+        if self._autotune is not None:
+            # Spec-depth channel (rung 26): verify passes have their
+            # own per-pass device cost t_v, so the spec window keeps
+            # its own EWMA stream. The pick applies only at a TRUE
+            # boundary (nothing in flight — the next spec dispatch is
+            # first=True and rebuilds from host tokens), never between
+            # kind-matched carry redispatches, and never above the
+            # operator's configured depth cap.
+            self._autotune.observe(
+                rtt_ms=(t_harvest - rec["t0"]) * 1e3,
+                device_ms=(t_harvest - t_force) * 1e3,
+                host_ms=host_ms, window=rec["window"],
+                channel="spec",
+            )
+            if self._inflight is None and self._spec_window_cap > 0:
+                pick = self._autotune.window(
+                    "spec", default=self._spec_window_cap)
+                self._spec_window = max(
+                    1, min(self._spec_window_cap, pick))
 
     def _drain_rec_locked(self, rec: dict | None) -> None:
         """Unwind one in-flight record on the failure path: restore

@@ -259,10 +259,30 @@ _SERVE_METRIC_FIELDS = (
      "counter",
      "checkpoints refused by the journal byte budget — those "
      "requests degrade to fail-and-retry on the next outage"),
+    ("checkpoint_unchanged_total", "serve_checkpoint_unchanged_total",
+     "counter",
+     "checkpoints delta-skipped at a boundary because the request's "
+     "standing journal entry already matched (gen_len, next_token) — "
+     "zero device work spent re-serializing identical state "
+     "(SERVING.md rung 26)"),
     ("journal_restores_total", "serve_journal_restores_total",
      "counter",
      "journaled in-flight requests re-admitted by revive()/"
      "reformation (direct slot restores + swap-set re-queues)"),
+    # Online window controller (runtime/autotune.py, SERVING.md rung
+    # 26, serving_window=auto): the per-boundary pick and its EWMA
+    # inputs. Present only when the controller is on.
+    ("autotune_window", "serve_autotune_window", "gauge",
+     "decode window the online controller currently picks — the "
+     "smallest power of two with window*t >= R (paged backend, "
+     "serving_window=auto)"),
+    ("autotune_r_ms", "serve_autotune_r_ms", "gauge",
+     "EWMA host turnaround per window (dispatch+harvest bookkeeping "
+     "the device window must hide), the controller's R input"),
+    ("autotune_t_ms", "serve_autotune_t_ms", "gauge",
+     "EWMA per-step device time, the controller's t input"),
+    ("autotune_updates", "serve_autotune_updates_total", "counter",
+     "harvested windows the controller has learned from"),
     # Request-scoped tracing (runtime/tracing.py, [payload]
     # serving_trace): flight-recorder occupancy and loss. Present only
     # while tracing is enabled.

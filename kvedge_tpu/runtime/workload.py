@@ -1421,7 +1421,12 @@ def _build_serve(cfg, base, tcfg, params, restored_step, *, cache=None,
                 spec_window=(cfg.serving_spec_window
                              if spec_draft > 0 else 0),
                 spec_sampled_window=cfg.serving_spec_sampled_window,
+                # "auto" hands window choice to the online controller
+                # (SERVING.md rung 26) inside the min/max bounds; a
+                # static int keeps the operator's cap.
                 window=cfg.serving_window,
+                window_min=cfg.serving_window_min,
+                window_max=cfg.serving_window_max,
                 kv_dtype=cfg.serving_kv_dtype,
                 cache=cache,
                 retry_after_s=cfg.serving_retry_after_s,

@@ -207,7 +207,13 @@ def run_chaos_campaign(params, tcfg, seed: int, *, rounds: int = 2,
                 # not poison it — raise/delay cover the poison and
                 # slow-path stories the soak is after.
                 kinds=("raise", "delay"),
-                fire_window=(1, rng.randrange(4, 24)),
+                # Coalesced boundary checkpoints (one swapout per
+                # boundary, unchanged requests skipped) mean a round
+                # crosses far fewer device seams than the per-request
+                # swapout era — indices past ~10 are reached only on
+                # lucky interleavings. Keep the drawn fire index low
+                # so every plan lands mid-flight deterministically.
+                fire_window=(1, rng.randrange(3, 10)),
                 delay_s=0.05,
             )
             cache.plan = plan

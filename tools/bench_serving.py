@@ -123,6 +123,22 @@ def main() -> int:
                 sched_strict["interactive_wait_p99_ms"],
             "sched_overload_fifo_interactive_wait_p99_ms":
                 sched_fifo["interactive_wait_p99_ms"],
+            # Exact client-side first-token latencies alongside the
+            # bucket-edge histogram numbers above — disagreement
+            # between the two is quantization artifact (SERVING.md
+            # rung 26 strict-vs-fifo verdict), not scheduling.
+            "sched_overload_interactive_ttft_p50_ms": round(
+                sched_strict["interactive_ttft_p50_ms"], 1),
+            "sched_overload_interactive_ttft_p99_ms": round(
+                sched_strict["interactive_ttft_p99_ms"], 1),
+            "sched_overload_fifo_interactive_ttft_p50_ms": round(
+                sched_fifo["interactive_ttft_p50_ms"], 1),
+            "sched_overload_fifo_interactive_ttft_p99_ms": round(
+                sched_fifo["interactive_ttft_p99_ms"], 1),
+            "sched_overload_batch_ttft_p99_ms": round(
+                sched_strict["batch_ttft_p99_ms"], 1),
+            "sched_overload_fifo_batch_ttft_p99_ms": round(
+                sched_fifo["batch_ttft_p99_ms"], 1),
             "sched_overload_preemptions": sched_strict["preemptions"],
         })
 

@@ -70,6 +70,12 @@ Usage::
                                          # device-time attribution,
                                          # occupancy ring, bundle
                                          # completeness); fast, tier-1
+    python tools/run_tests.py --autotune # only the window-controller
+                                         # tests (-m autotune:
+                                         # convergence to the model
+                                         # optimum, auto-vs-static
+                                         # bit-identity, revive/
+                                         # reformation); fast, tier-1
     python tools/run_tests.py --lint     # lock-discipline gate: runs
                                          # tools/locklint.py over the
                                          # package (fast-fails on any
@@ -249,6 +255,11 @@ def main(argv: list[str] | None = None) -> int:
                          "tests (forwards -m slo: burn-rate windows, "
                          "device-time attribution, occupancy ring, "
                          "bundle completeness)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="run only the online window-controller tests "
+                         "(forwards -m autotune: convergence to the "
+                         "rung-16/20 model optimum, auto-vs-static "
+                         "bit-identity, revive/reformation survival)")
     ap.add_argument("--lint", action="store_true",
                     help="run the lock-discipline gate: tools/locklint.py "
                          "over kvedge_tpu/, then the analyzer's own tests "
@@ -284,6 +295,8 @@ def main(argv: list[str] | None = None) -> int:
         args.pytest_args += ["-m", "prefix"]
     if args.slo:
         args.pytest_args += ["-m", "slo"]
+    if args.autotune:
+        args.pytest_args += ["-m", "autotune"]
     if args.lint:
         # The analyzer gate runs FIRST and fast-fails: a tree with
         # unsuppressed findings should not spend minutes in pytest
